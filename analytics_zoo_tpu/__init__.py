@@ -1,0 +1,37 @@
+"""analytics_zoo_tpu: a TPU-native unified analytics + AI platform.
+
+A from-scratch, JAX/XLA/Pallas-first rebuild of the capabilities of
+Analytics Zoo (reference: /root/reference, jiechenghan/analytics-zoo).
+Where the reference stacks Python -> Py4J -> Scala -> JNI over
+Spark/Flink/Ray with five data-parallel communication backends, this
+framework is one SPMD runtime: ``pjit``/``shard_map`` over a
+``jax.sharding.Mesh`` with XLA collectives on ICI/DCN.
+
+Top-level subpackages (reference analog in parens):
+
+- ``common``   -- context/config/logging/triggers  (NNContext, ZooContext, ZooTrigger)
+- ``utils``    -- nest, tensorboard writer, io     (util/nest.py, zoo/tensorboard)
+- ``parallel`` -- mesh, shardings, collectives, ring attention, pipeline
+                  (the five comm backends of SURVEY.md section 2.3, unified)
+- ``data``     -- XShards, sharded datasets, feature preprocessing
+                  (TFDataset, FeatureSet, XShards)
+- ``keras``    -- Keras-style layer library + Sequential/Model
+                  (zoo/pipeline/api/keras)
+- ``learn``    -- Estimator: distributed fit/evaluate/predict
+                  (InternalDistriOptimizer, zoo Estimator, Orca Estimator)
+- ``ops``      -- Pallas TPU kernels (flash attention, ...)
+- ``inference``-- InferenceModel multi-format inference runtime
+- ``serving``  -- streaming model serving: queue + batcher + HTTP frontend
+- ``models``   -- model zoo: recommendation, NLP, vision, time series
+- ``automl``   -- hyperparameter search engine + recipes
+- ``zouwu``    -- time series: forecasters, AutoTS, anomaly detection
+"""
+
+from analytics_zoo_tpu.version import __version__  # noqa: F401
+
+from analytics_zoo_tpu.common.context import (  # noqa: F401
+    ZooContext,
+    init_zoo_context,
+    init_orca_context,
+    stop_orca_context,
+)

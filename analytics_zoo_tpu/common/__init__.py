@@ -1,0 +1,40 @@
+from analytics_zoo_tpu.common.config import ZooConfig, get_config
+from analytics_zoo_tpu.common.context import (
+    ZooContext,
+    init_zoo_context,
+    init_orca_context,
+    stop_orca_context,
+)
+from analytics_zoo_tpu.common.triggers import (
+    Trigger,
+    TriggerState,
+    EveryEpoch,
+    SeveralIteration,
+    MaxEpoch,
+    MaxIteration,
+    MaxScore,
+    MinLoss,
+    TimeLimit,
+    And,
+    Or,
+)
+
+__all__ = [
+    "ZooConfig",
+    "get_config",
+    "ZooContext",
+    "init_zoo_context",
+    "init_orca_context",
+    "stop_orca_context",
+    "Trigger",
+    "TriggerState",
+    "EveryEpoch",
+    "SeveralIteration",
+    "MaxEpoch",
+    "MaxIteration",
+    "MaxScore",
+    "MinLoss",
+    "TimeLimit",
+    "And",
+    "Or",
+]

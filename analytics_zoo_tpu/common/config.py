@@ -1,0 +1,143 @@
+"""Layered configuration system.
+
+The reference layers Spark properties (packaged defaults file +
+``spark.analytics.zoo.*`` overrides), JVM system properties, and env vars
+(ref: zoo/.../common/NNContext.scala:189-247, SURVEY.md section 5 "Config").
+Here the layers are, lowest to highest precedence:
+
+1. built-in defaults (``_DEFAULTS``)
+2. an optional config file (``analytics-zoo-tpu.conf``, ``key value`` lines,
+   the analog of ``spark-analytics-zoo.conf``)
+3. environment variables ``AZT_<KEY>`` (dots -> underscores, uppercased)
+4. programmatic ``set()`` calls
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_DEFAULTS: Dict[str, Any] = {
+    # training
+    "zoo.train.failure.retry_times": 5,          # ref: bigdl.failure.retryTimes (Topology.scala:1256)
+    "zoo.train.failure.retry_interval_s": 120,   # ref: bigdl.failure.retryTimeInterval
+    "zoo.train.log_every_n_steps": 50,
+    "zoo.train.donate_buffers": True,
+    # mesh / parallelism axis names
+    "zoo.mesh.axis.data": "data",
+    "zoo.mesh.axis.model": "model",
+    "zoo.mesh.axis.sequence": "seq",
+    "zoo.mesh.axis.pipeline": "pipe",
+    "zoo.mesh.axis.expert": "expert",
+    # data layer
+    "zoo.data.prefetch_buffer": 2,
+    "zoo.data.check_batch_divisible": True,      # ref: tf_dataset.py:142-147 batch % cores == 0
+    # serving
+    "zoo.serving.batch_size": 8,
+    "zoo.serving.batch_timeout_ms": 5,
+    "zoo.serving.http_port": 10020,
+    # inference
+    "zoo.inference.default_dtype": "bfloat16",
+}
+
+_ENV_PREFIX = "AZT_"
+
+
+def _coerce(value: str) -> Any:
+    low = value.strip()
+    if low.lower() in ("true", "false"):
+        return low.lower() == "true"
+    for conv in (int, float):
+        try:
+            return conv(low)
+        except ValueError:
+            pass
+    return low
+
+
+class ZooConfig:
+    """Thread-safe layered key/value config."""
+
+    def __init__(self, conf_file: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._overrides: Dict[str, Any] = {}
+        self._file_layer: Dict[str, Any] = {}
+        if conf_file is None:
+            conf_file = os.environ.get("AZT_CONF_FILE", "analytics-zoo-tpu.conf")
+        if conf_file and os.path.isfile(conf_file):
+            self._file_layer = self._parse_conf_file(conf_file)
+
+    @staticmethod
+    def _parse_conf_file(path: str) -> Dict[str, Any]:
+        layer: Dict[str, Any] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split(None, 1)
+                if len(parts) == 2:
+                    layer[parts[0]] = _coerce(parts[1])
+        return layer
+
+    def _env_lookup(self, key: str) -> Optional[str]:
+        env_key = _ENV_PREFIX + key.replace(".", "_").upper()
+        return os.environ.get(env_key)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            if key in self._overrides:
+                return self._overrides[key]
+        env_val = self._env_lookup(key)
+        if env_val is not None:
+            return _coerce(env_val)
+        if key in self._file_layer:
+            return self._file_layer[key]
+        return _DEFAULTS.get(key, default)
+
+    def set(self, key: str, value: Any) -> "ZooConfig":
+        with self._lock:
+            self._overrides[key] = value
+        return self
+
+    def unset(self, key: str) -> "ZooConfig":
+        with self._lock:
+            self._overrides.pop(key, None)
+        return self
+
+    def as_dict(self) -> Dict[str, Any]:
+        merged = dict(_DEFAULTS)
+        merged.update(self._file_layer)
+        # env-only keys: AZT_FOO_BAR -> foo.bar (lossy for keys whose
+        # canonical form contains underscores; get() remains authoritative)
+        for env_key, env_val in os.environ.items():
+            if env_key.startswith(_ENV_PREFIX) and env_key != "AZT_CONF_FILE":
+                key = env_key[len(_ENV_PREFIX):].lower().replace("_", ".")
+                if key not in merged:
+                    merged[key] = _coerce(env_val)
+        for key in list(merged):
+            env_val = self._env_lookup(key)
+            if env_val is not None:
+                merged[key] = _coerce(env_val)
+        with self._lock:
+            merged.update(self._overrides)
+        return merged
+
+
+_global_config: Optional[ZooConfig] = None
+_config_lock = threading.Lock()
+
+
+def get_config() -> ZooConfig:
+    global _global_config
+    with _config_lock:
+        if _global_config is None:
+            _global_config = ZooConfig()
+        return _global_config
+
+
+def reset_config() -> None:
+    global _global_config
+    with _config_lock:
+        _global_config = None

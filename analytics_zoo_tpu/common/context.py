@@ -1,0 +1,193 @@
+"""Cluster/runtime context initialization.
+
+The TPU-native analog of the reference's ``NNContext.initNNContext`` +
+``init_orca_context`` (ref: zoo/.../common/NNContext.scala:134-150,
+pyzoo/zoo/common/nncontext.py:319-392, pyzoo/zoo/orca/common.py:21-218).
+
+Where the reference creates a SparkContext, pins MKL/OMP env, initializes the
+BigDL engine, and optionally boots a Ray cluster inside Spark executors
+(RayOnSpark), here one call:
+
+- optionally initializes ``jax.distributed`` for multi-host (DCN) runs
+  (the analog of the cluster bootstrap in init_spark_on_yarn/k8s),
+- discovers local + global devices,
+- builds the default device mesh (data-parallel unless told otherwise),
+- installs the global config.
+
+There is exactly ONE runtime to initialize -- JAX SPMD -- instead of five
+(Spark+BigDL, Ray, Flink, Horovod, MXNet PS); see SURVEY.md section 2.3.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.common.config import ZooConfig, get_config
+from analytics_zoo_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class ZooContext:
+    """Singleton runtime context.
+
+    Attributes:
+      config: the layered ZooConfig.
+      devices: global (across hosts) jax devices.
+      local_devices: devices attached to this host/process.
+      mesh: the default ``jax.sharding.Mesh`` (data-parallel over all
+        devices unless ``mesh_shape`` was given at init).
+    """
+
+    _instance: Optional["ZooContext"] = None
+    _lock = threading.Lock()
+
+    # class-level feature flags, the analog of the reference ZooContext
+    # metaclass properties (ref: pyzoo/zoo/common/nncontext.py:269-316)
+    log_output: bool = True
+
+    def __init__(
+        self,
+        cluster_mode: str = "local",
+        mesh_shape: Optional[Dict[str, int]] = None,
+        config: Optional[ZooConfig] = None,
+    ):
+        self.cluster_mode = cluster_mode
+        self.config = config or get_config()
+        self.devices = jax.devices()
+        self.local_devices = jax.local_devices()
+        self.num_processes = jax.process_count()
+        self.process_id = jax.process_index()
+        self._mesh_shape = mesh_shape
+        self.mesh = self._build_mesh(mesh_shape)
+
+    def _build_mesh(self, mesh_shape: Optional[Dict[str, int]]):
+        from jax.sharding import Mesh
+
+        n = len(self.devices)
+        if not mesh_shape:
+            axis = self.config.get("zoo.mesh.axis.data")
+            return Mesh(np.asarray(self.devices).reshape(n), (axis,))
+        names = tuple(mesh_shape.keys())
+        sizes = tuple(mesh_shape.values())
+        total = int(np.prod(sizes))
+        if total != n:
+            raise ValueError(
+                f"mesh shape {mesh_shape} needs {total} devices, have {n}"
+            )
+        dev_array = np.asarray(self.devices).reshape(sizes)
+        return Mesh(dev_array, names)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    def barrier(self, name: str = "zoo_barrier") -> None:
+        """Block until all processes reach this point (no-op single-host)."""
+        if self.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+
+    def stop(self) -> None:
+        with ZooContext._lock:
+            if ZooContext._instance is not self:
+                return  # stale handle; don't tear down a newer context
+            ZooContext._instance = None
+        if self.cluster_mode == "multihost":
+            try:
+                jax.distributed.shutdown()
+            except RuntimeError:
+                pass
+
+    @classmethod
+    def get(cls) -> Optional["ZooContext"]:
+        with cls._lock:
+            return cls._instance
+
+
+def init_zoo_context(
+    cluster_mode: str = "local",
+    mesh_shape: Optional[Dict[str, int]] = None,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    conf: Optional[Dict[str, Any]] = None,
+) -> ZooContext:
+    """Initialize (or fetch) the global runtime context.
+
+    Args:
+      cluster_mode: "local" (single host, all local chips) or "multihost"
+        (jax.distributed over DCN; the analog of init_spark_on_yarn/k8s,
+        ref: pyzoo/zoo/common/nncontext.py:31-244).
+      mesh_shape: optional ordered {axis_name: size} for the default mesh,
+        e.g. {"data": 8} or {"data": 2, "model": 4}. Defaults to pure
+        data parallelism over every visible device.
+      coordinator_address / num_processes / process_id: multihost rendezvous
+        parameters, forwarded to ``jax.distributed.initialize``.
+      conf: extra config overrides, applied to the global ZooConfig
+        (the analog of extra spark conf dict).
+    """
+    if cluster_mode not in ("local", "multihost"):
+        raise ValueError(
+            f"unknown cluster_mode {cluster_mode!r}; use 'local' or 'multihost'"
+        )
+
+    with ZooContext._lock:
+        if ZooContext._instance is not None:
+            existing = ZooContext._instance
+            if (mesh_shape is not None and mesh_shape != existing._mesh_shape) \
+                    or cluster_mode != existing.cluster_mode or conf:
+                logger.warning(
+                    "init_zoo_context called with new arguments but a context "
+                    "already exists; returning the existing context "
+                    "(mode=%s, mesh=%s). Call stop_orca_context() first to "
+                    "re-initialize.", existing.cluster_mode,
+                    dict(zip(existing.mesh.axis_names,
+                             existing.mesh.devices.shape)))
+            return existing
+
+        if cluster_mode == "multihost":
+            kwargs: Dict[str, Any] = {}
+            if coordinator_address is not None:
+                kwargs["coordinator_address"] = coordinator_address
+            if num_processes is not None:
+                kwargs["num_processes"] = num_processes
+            if process_id is not None:
+                kwargs["process_id"] = process_id
+            jax.distributed.initialize(**kwargs)
+
+        config = get_config()
+        if conf:
+            for k, v in conf.items():
+                config.set(k, v)
+
+        ctx = ZooContext(cluster_mode=cluster_mode, mesh_shape=mesh_shape,
+                         config=config)
+        ZooContext._instance = ctx
+    logger.info(
+        "initialized ZooContext: mode=%s processes=%d devices=%d mesh=%s",
+        cluster_mode, ctx.num_processes, ctx.num_devices,
+        dict(zip(ctx.mesh.axis_names, ctx.mesh.devices.shape)),
+    )
+    return ctx
+
+
+# Orca-compatible aliases (ref: pyzoo/zoo/orca/common.py init_orca_context /
+# stop_orca_context): one unified entry point for users of the reference API.
+def init_orca_context(cluster_mode: str = "local", **kwargs) -> ZooContext:
+    return init_zoo_context(cluster_mode=cluster_mode, **kwargs)
+
+
+def stop_orca_context() -> None:
+    ctx = ZooContext.get()
+    if ctx is not None:
+        ctx.stop()
+
+
+atexit.register(stop_orca_context)

@@ -1,0 +1,110 @@
+"""Logging + lightweight timing instrumentation.
+
+Timing helpers mirror the reference's ``Supportive.timing(name){...}``
+(ref: zoo/.../serving/utils/Supportive.scala:22) and ``EstimateSupportive``
+wrappers; per-stage stats mirror the serving ``Timer``
+(ref: zoo/.../serving/engine/Timer.scala:24-90: total/avg/max/min/topN).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+_LOG_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+_configured = False
+_lock = threading.Lock()
+
+
+def get_logger(name: str = "analytics_zoo_tpu") -> logging.Logger:
+    global _configured
+    with _lock:
+        if not _configured:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(logging.Formatter(_LOG_FORMAT))
+            root = logging.getLogger("analytics_zoo_tpu")
+            if not root.handlers:
+                root.addHandler(handler)
+            root.setLevel(logging.INFO)
+            root.propagate = False
+            _configured = True
+    return logging.getLogger(name)
+
+
+class TimerStat:
+    """Accumulated stats for one named stage (count/total/avg/max/min/top-k)."""
+
+    __slots__ = ("name", "count", "total", "max", "min", "_topk", "_k")
+
+    def __init__(self, name: str, k: int = 10):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+        self._topk: List[float] = []
+        self._k = k
+
+    def record(self, elapsed: float) -> None:
+        self.count += 1
+        self.total += elapsed
+        self.max = max(self.max, elapsed)
+        self.min = min(self.min, elapsed)
+        self._topk.append(elapsed)
+        self._topk.sort(reverse=True)
+        del self._topk[self._k:]
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def top(self, n: int = 10) -> List[float]:
+        return self._topk[:n]
+
+    def summary(self) -> str:
+        return (
+            f"[{self.name}] count={self.count} total={self.total:.4f}s "
+            f"avg={self.avg * 1e3:.2f}ms max={self.max * 1e3:.2f}ms "
+            f"min={(0.0 if self.min == float('inf') else self.min) * 1e3:.2f}ms"
+        )
+
+
+class Timer:
+    """Named-stage timer registry; thread-safe."""
+
+    def __init__(self):
+        self._stats: Dict[str, TimerStat] = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def timing(self, name: str, log: Optional[logging.Logger] = None):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            with self._lock:
+                stat = self._stats.setdefault(name, TimerStat(name))
+                stat.record(elapsed)
+            if log is not None:
+                log.info("%s took %.2f ms", name, elapsed * 1e3)
+
+    def stat(self, name: str) -> Optional[TimerStat]:
+        with self._lock:
+            return self._stats.get(name)
+
+    def summaries(self) -> List[str]:
+        with self._lock:
+            return [s.summary() for s in self._stats.values()]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+GLOBAL_TIMER = Timer()
+timing = GLOBAL_TIMER.timing

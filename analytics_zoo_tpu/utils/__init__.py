@@ -1,0 +1,2 @@
+from analytics_zoo_tpu.utils import nest  # noqa: F401
+from analytics_zoo_tpu.utils.summary import SummaryWriter, read_events  # noqa: F401

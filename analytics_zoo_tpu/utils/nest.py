@@ -1,0 +1,37 @@
+"""Nested-structure flatten/pack utilities.
+
+The analog of the reference's ``zoo.util.nest`` (ref:
+pyzoo/zoo/util/nest.py), which TFPark uses to marshal arbitrarily nested
+(feature, label) structures. Here jax pytrees already provide the
+machinery; these wrappers keep the reference's API names and add
+deterministic dict ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import jax
+
+
+def flatten(structure: Any) -> List[Any]:
+    """Flatten a nested structure (dicts sorted by key, like pytrees)."""
+    leaves, _ = jax.tree_util.tree_flatten(structure)
+    return leaves
+
+
+def pack_sequence_as(structure: Any, flat_sequence: Sequence[Any]) -> Any:
+    """Inverse of :func:`flatten` given a template ``structure``."""
+    _, treedef = jax.tree_util.tree_flatten(structure)
+    return jax.tree_util.tree_unflatten(treedef, list(flat_sequence))
+
+
+def map_structure(fn, structure: Any) -> Any:
+    return jax.tree_util.tree_map(fn, structure)
+
+
+def assert_same_structure(a: Any, b: Any) -> None:
+    ta = jax.tree_util.tree_structure(a)
+    tb = jax.tree_util.tree_structure(b)
+    if ta != tb:
+        raise ValueError(f"structures differ: {ta} vs {tb}")

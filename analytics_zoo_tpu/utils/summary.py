@@ -1,0 +1,323 @@
+"""TensorBoard-compatible event files, written from scratch.
+
+The reference implements TF-format event writing without depending on
+TensorFlow (ref: zoo/.../tensorboard/EventWriter.scala:32-80,
+FileWriter.scala:32-60, Summary.scala); this module does the same in pure
+Python: hand-encoded protobuf wire format for the ``Event``/``Summary``
+messages plus the TFRecord framing (length + masked CRC32C records).
+
+Wire facts used (stable public TF format):
+  Event:   double wall_time = 1; int64 step = 2;
+           string file_version = 3; Summary summary = 5;
+  Summary: repeated Value value = 1;
+  Value:   string tag = 1; float simple_value = 2; HistogramProto histo = 5;
+  HistogramProto: double min=1,max=2,num=3,sum=4,sum_squares=5;
+           repeated double bucket_limit=6 [packed]; repeated double bucket=7.
+Record framing: uint64le(len) crc(len) payload crc(payload), where
+crc = masked crc32c as in TFRecord.
+
+Readback (``read_events``) supports the Estimator's
+``get_train_summary``/``get_validation_summary`` analog
+(ref: Topology.scala:1390-1404).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------- crc32c ---
+
+_CRC_TABLE: List[int] = []
+
+
+def _make_table() -> None:
+    poly = 0x82F63B78  # Castagnoli, reflected
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        _CRC_TABLE.append(crc)
+
+
+_make_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+# ------------------------------------------------------- proto wire enc ---
+
+
+def _varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return _varint((field << 3) | wire_type)
+
+
+def _enc_double(field: int, v: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", v)
+
+
+def _enc_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def _enc_int64(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _enc_bytes(field: int, v: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(v)) + v
+
+
+def _enc_string(field: int, v: str) -> bytes:
+    return _enc_bytes(field, v.encode("utf-8"))
+
+
+def _enc_packed_doubles(field: int, vs) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in vs)
+    return _enc_bytes(field, payload)
+
+
+def _encode_histogram(values: np.ndarray) -> bytes:
+    """HistogramProto from raw values, TF-style exponential buckets."""
+    values = np.asarray(values, dtype=np.float64).ravel()
+    if values.size == 0:
+        values = np.zeros(1)
+    limits: List[float] = []
+    v = 1e-12
+    while v < 1e20:
+        limits.append(v)
+        v *= 1.1
+    limits = sorted([-x for x in limits]) + limits + [1.7976931348623157e308]
+    bucket_limit = np.asarray(limits)
+    counts, _ = np.histogram(values, bins=np.concatenate(
+        [[-1.7976931348623157e308], bucket_limit]))
+    nz = counts.nonzero()[0]
+    if nz.size:  # trim empty tail/head buckets but keep alignment
+        lo, hi = nz[0], nz[-1] + 1
+    else:
+        lo, hi = 0, 1
+    msg = b"".join([
+        _enc_double(1, float(values.min())),
+        _enc_double(2, float(values.max())),
+        _enc_double(3, float(values.size)),
+        _enc_double(4, float(values.sum())),
+        _enc_double(5, float(np.square(values).sum())),
+        _enc_packed_doubles(6, bucket_limit[lo:hi]),
+        _enc_packed_doubles(7, counts[lo:hi]),
+    ])
+    return msg
+
+
+def encode_scalar_event(tag: str, value: float, step: int,
+                        wall_time: Optional[float] = None) -> bytes:
+    value_msg = _enc_string(1, tag) + _enc_float(2, float(value))
+    summary = _enc_bytes(1, value_msg)
+    return b"".join([
+        _enc_double(1, wall_time if wall_time is not None else time.time()),
+        _enc_int64(2, step),
+        _enc_bytes(5, summary),
+    ])
+
+
+def encode_histogram_event(tag: str, values, step: int,
+                           wall_time: Optional[float] = None) -> bytes:
+    histo = _encode_histogram(np.asarray(values))
+    value_msg = _enc_string(1, tag) + _enc_bytes(5, histo)
+    summary = _enc_bytes(1, value_msg)
+    return b"".join([
+        _enc_double(1, wall_time if wall_time is not None else time.time()),
+        _enc_int64(2, step),
+        _enc_bytes(5, summary),
+    ])
+
+
+def _file_version_event() -> bytes:
+    return _enc_double(1, time.time()) + _enc_string(3, "brain.Event:2")
+
+
+# ------------------------------------------------------------- records ---
+
+
+def _write_record(f, payload: bytes) -> None:
+    header = struct.pack("<Q", len(payload))
+    f.write(header)
+    f.write(struct.pack("<I", _masked_crc(header)))
+    f.write(payload)
+    f.write(struct.pack("<I", _masked_crc(payload)))
+
+
+def _read_records(path: str) -> Iterator[bytes]:
+    """Yield records, stopping at the first truncated or CRC-corrupt one."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            len_crc = f.read(4)
+            if len(len_crc) < 4 or \
+                    struct.unpack("<I", len_crc)[0] != _masked_crc(header):
+                return  # corrupted length field: cannot trust framing
+            (length,) = struct.unpack("<Q", header)
+            payload = f.read(length)
+            if len(payload) < length:
+                return
+            payload_crc = f.read(4)
+            if len(payload_crc) < 4 or \
+                    struct.unpack("<I", payload_crc)[0] != _masked_crc(payload):
+                return  # corrupted payload
+            yield payload
+
+
+# -------------------------------------------------------------- writer ---
+
+
+class SummaryWriter:
+    """Append-only TB event writer for one log dir.
+
+    The analog of ``FileWriter`` + ``EventWriter`` (buffered, background
+    flush) -- here synchronous-with-flush-interval for simplicity.
+    """
+
+    def __init__(self, log_dir: str, flush_every: int = 20):
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_dir = log_dir
+        fname = f"events.out.tfevents.{int(time.time())}.analytics-zoo-tpu"
+        self._path = os.path.join(log_dir, fname)
+        self._file = open(self._path, "ab")
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._flush_every = flush_every
+        with self._lock:
+            _write_record(self._file, _file_version_event())
+            self._file.flush()
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        with self._lock:
+            _write_record(self._file, encode_scalar_event(tag, value, step))
+            self._maybe_flush()
+
+    def add_histogram(self, tag: str, values, step: int) -> None:
+        with self._lock:
+            _write_record(self._file,
+                          encode_histogram_event(tag, values, step))
+            self._maybe_flush()
+
+    def _maybe_flush(self) -> None:
+        self._pending += 1
+        if self._pending >= self._flush_every:
+            self._file.flush()
+            self._pending = 0
+
+    def flush(self) -> None:
+        with self._lock:
+            self._file.flush()
+            self._pending = 0
+
+    def close(self) -> None:
+        with self._lock:
+            self._file.flush()
+            self._file.close()
+
+
+# -------------------------------------------------------------- reader ---
+
+
+def _decode_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _iter_fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
+    pos = 0
+    while pos < len(buf):
+        key, pos = _decode_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            v, pos = _decode_varint(buf, pos)
+            yield field, wt, _varint(v)
+        elif wt == 1:
+            yield field, wt, buf[pos:pos + 8]
+            pos += 8
+        elif wt == 5:
+            yield field, wt, buf[pos:pos + 4]
+            pos += 4
+        elif wt == 2:
+            ln, pos = _decode_varint(buf, pos)
+            yield field, wt, buf[pos:pos + ln]
+            pos += ln
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+
+
+def read_events(log_dir_or_file: str) -> Dict[str, List[Tuple[int, float]]]:
+    """Read scalar events back: {tag: [(step, value), ...]}.
+
+    Supports ``get_train_summary``-style readback
+    (ref: Topology.scala:1390-1404).
+    """
+    if os.path.isdir(log_dir_or_file):
+        files = sorted(
+            os.path.join(log_dir_or_file, f)
+            for f in os.listdir(log_dir_or_file)
+            if "tfevents" in f
+        )
+    else:
+        files = [log_dir_or_file]
+    out: Dict[str, List[Tuple[int, float]]] = {}
+    for path in files:
+        for record in _read_records(path):
+            step = 0
+            summary = None
+            for field, wt, data in _iter_fields(record):
+                if field == 2 and wt == 0:
+                    step, _ = _decode_varint(data, 0)
+                elif field == 5 and wt == 2:
+                    summary = data
+            if summary is None:
+                continue
+            for field, wt, data in _iter_fields(summary):
+                if field != 1 or wt != 2:
+                    continue
+                tag, sval = None, None
+                for f2, w2, d2 in _iter_fields(data):
+                    if f2 == 1 and w2 == 2:
+                        tag = d2.decode("utf-8")
+                    elif f2 == 2 and w2 == 5:
+                        (sval,) = struct.unpack("<f", d2)
+                if tag is not None and sval is not None:
+                    out.setdefault(tag, []).append((step, sval))
+    return out

@@ -1,0 +1,67 @@
+"""Tests for utils: nest, tensorboard event writer (golden vs TF reader)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.utils import nest
+from analytics_zoo_tpu.utils.summary import (
+    SummaryWriter,
+    crc32c,
+    read_events,
+)
+
+
+class TestNest:
+    def test_flatten_pack_roundtrip(self):
+        struct = {"a": [1, 2], "b": {"c": 3}}
+        flat = nest.flatten(struct)
+        assert flat == [1, 2, 3]
+        rebuilt = nest.pack_sequence_as(struct, [x * 10 for x in flat])
+        assert rebuilt == {"a": [10, 20], "b": {"c": 30}}
+
+    def test_assert_same_structure(self):
+        nest.assert_same_structure({"a": 1}, {"a": 2})
+        with pytest.raises(ValueError):
+            nest.assert_same_structure({"a": 1}, [1])
+
+
+class TestCrc32c:
+    def test_known_vectors(self):
+        # RFC 3720 test vector: 32 bytes of zeros -> 0x8A9136AA
+        assert crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert crc32c(b"123456789") == 0xE3069283
+
+
+class TestSummaryWriter:
+    def test_write_and_read_back(self, tmp_path):
+        d = str(tmp_path / "logs")
+        w = SummaryWriter(d)
+        for step in range(5):
+            w.add_scalar("Loss", 1.0 / (step + 1), step)
+        w.add_scalar("Throughput", 1000.0, 4)
+        w.add_histogram("weights", np.random.randn(100), 4)
+        w.close()
+        events = read_events(d)
+        assert [s for s, _ in events["Loss"]] == [0, 1, 2, 3, 4]
+        assert events["Loss"][0][1] == pytest.approx(1.0)
+        assert events["Throughput"] == [(4, 1000.0)]
+
+    def test_tensorflow_can_read_our_events(self, tmp_path):
+        """Golden test: the real TF event reader parses our files."""
+        tf = pytest.importorskip("tensorflow")
+        d = str(tmp_path / "logs")
+        w = SummaryWriter(d)
+        w.add_scalar("acc", 0.75, 3)
+        w.add_histogram("h", np.arange(10.0), 3)
+        w.close()
+        import glob
+        path = glob.glob(d + "/events*")[0]
+        got = {}
+        for ev in tf.compat.v1.train.summary_iterator(path):
+            for v in ev.summary.value:
+                if v.HasField("simple_value"):
+                    got[v.tag] = (ev.step, v.simple_value)
+                if v.HasField("histo"):
+                    got[v.tag] = (ev.step, v.histo.num)
+        assert got["acc"] == (3, pytest.approx(0.75))
+        assert got["h"] == (3, 10.0)
