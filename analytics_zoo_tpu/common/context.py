@@ -67,21 +67,14 @@ class ZooContext:
         self.mesh = self._build_mesh(mesh_shape)
 
     def _build_mesh(self, mesh_shape: Optional[Dict[str, int]]):
-        from jax.sharding import Mesh
+        # delegate to the canonical builder: hybrid ICI x DCN layout on
+        # multi-host, -1 axis inference, validation.
+        from analytics_zoo_tpu.parallel.mesh import create_mesh
 
-        n = len(self.devices)
         if not mesh_shape:
             axis = self.config.get("zoo.mesh.axis.data")
-            return Mesh(np.asarray(self.devices).reshape(n), (axis,))
-        names = tuple(mesh_shape.keys())
-        sizes = tuple(mesh_shape.values())
-        total = int(np.prod(sizes))
-        if total != n:
-            raise ValueError(
-                f"mesh shape {mesh_shape} needs {total} devices, have {n}"
-            )
-        dev_array = np.asarray(self.devices).reshape(sizes)
-        return Mesh(dev_array, names)
+            return create_mesh({axis: len(self.devices)})
+        return create_mesh(mesh_shape)
 
     @property
     def num_devices(self) -> int:
@@ -152,6 +145,7 @@ def init_zoo_context(
                              existing.mesh.devices.shape)))
             return existing
 
+        dist_started_here = False
         if cluster_mode == "multihost":
             kwargs: Dict[str, Any] = {}
             if coordinator_address is not None:
@@ -160,15 +154,31 @@ def init_zoo_context(
                 kwargs["num_processes"] = num_processes
             if process_id is not None:
                 kwargs["process_id"] = process_id
-            jax.distributed.initialize(**kwargs)
+            try:
+                jax.distributed.initialize(**kwargs)
+                dist_started_here = True
+            except RuntimeError as e:
+                # already initialized (e.g. a previous attempt failed after
+                # this point): reuse the existing distributed runtime rather
+                # than poisoning every future init.
+                if "already initialized" not in str(e):
+                    raise
 
         config = get_config()
         if conf:
             for k, v in conf.items():
                 config.set(k, v)
 
-        ctx = ZooContext(cluster_mode=cluster_mode, mesh_shape=mesh_shape,
-                         config=config)
+        try:
+            ctx = ZooContext(cluster_mode=cluster_mode, mesh_shape=mesh_shape,
+                             config=config)
+        except Exception:
+            if dist_started_here:
+                try:
+                    jax.distributed.shutdown()
+                except RuntimeError:
+                    pass
+            raise
         ZooContext._instance = ctx
     logger.info(
         "initialized ZooContext: mode=%s processes=%d devices=%d mesh=%s",

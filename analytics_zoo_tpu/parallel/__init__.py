@@ -1,0 +1,36 @@
+"""Unified SPMD parallelism layer.
+
+The reference implements five coexisting data-parallel communication
+backends (Spark BlockManager allreduce, TF collectives, Gloo, Horovod,
+MXNet PS-Lite -- SURVEY.md section 2.3). On TPU there is exactly one:
+XLA collectives over ICI/DCN, driven by ``jax.sharding.Mesh`` +
+``jax.jit``/``jax.shard_map``. This package provides:
+
+- ``mesh``        -- device-mesh construction (single host, multi-host hybrid
+                     ICI x DCN meshes)
+- ``sharding``    -- NamedSharding helpers, batch/param placement
+- ``collectives`` -- psum/all_gather/reduce_scatter/ppermute wrappers
+- ``ring_attention`` -- sequence-parallel blockwise attention over a ring
+                     (new capability; the reference has no long-context
+                     support, SURVEY.md section 5)
+- ``pipeline``    -- pipeline-parallel stage execution via collective permute
+"""
+
+from analytics_zoo_tpu.parallel.mesh import (  # noqa: F401
+    create_mesh,
+    default_mesh,
+    mesh_axis_size,
+)
+from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
+    named_sharding,
+    replicated,
+    shard_batch,
+    shard_pytree,
+    data_parallel_spec,
+)
+from analytics_zoo_tpu.parallel import collectives  # noqa: F401
+from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_self_attention,
+)
+from analytics_zoo_tpu.parallel.pipeline import pipeline_apply  # noqa: F401

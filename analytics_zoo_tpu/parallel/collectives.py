@@ -1,0 +1,67 @@
+"""Collective primitives for use inside ``shard_map``-ed functions.
+
+One set of XLA collectives replaces the reference's five transport stacks
+(Spark BlockManager shuffle+broadcast, TF RING collectives, Gloo, Horovod,
+MXNet PS-Lite -- SURVEY.md section 2.3). The semantics of BigDL's
+``AllReduceParameter`` (reduce-scatter then re-fetch == allreduce,
+ref: docs/docs/wp-bigdl.md:138-160) are exactly ``psum``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce_sum(x: Any, axis_name: str) -> Any:
+    return jax.tree_util.tree_map(lambda t: lax.psum(t, axis_name), x)
+
+
+def all_reduce_mean(x: Any, axis_name: str) -> Any:
+    return jax.tree_util.tree_map(lambda t: lax.pmean(t, axis_name), x)
+
+
+def all_gather(x: Any, axis_name: str, axis: int = 0, tiled: bool = True) -> Any:
+    return jax.tree_util.tree_map(
+        lambda t: lax.all_gather(t, axis_name, axis=axis, tiled=tiled), x)
+
+
+def reduce_scatter(x: Any, axis_name: str, axis: int = 0) -> Any:
+    return jax.tree_util.tree_map(
+        lambda t: lax.psum_scatter(t, axis_name, scatter_dimension=axis,
+                                   tiled=True), x)
+
+
+def ring_permute(x: Any, axis_name: str, shift: int = 1) -> Any:
+    """Send to the next device on the ring (rank -> rank+shift mod N)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.tree_util.tree_map(
+        lambda t: lax.ppermute(t, axis_name, perm), x)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)
+
+
+def global_norm(tree: Any, axis_name: str = None) -> jnp.ndarray:
+    """L2 norm over an entire pytree (used for global gradient clipping,
+    matching the reference's global-gradient L2 clipping semantics,
+    ref: pyzoo/zoo/tfpark/tf_optimizer.py:392-396).
+
+    When the tree's leaves are *sharded* across a mesh axis inside a
+    ``shard_map`` body (e.g. FSDP), pass ``axis_name`` so the squared sum
+    is psum-reduced to the true global norm instead of a per-shard norm.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    sq = sum(jnp.sum(jnp.square(l)) for l in leaves)
+    if axis_name is not None:
+        sq = lax.psum(sq, axis_name)
+    return jnp.sqrt(sq)

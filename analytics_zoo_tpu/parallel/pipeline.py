@@ -1,0 +1,96 @@
+"""Pipeline parallelism via collective permute.
+
+New capability relative to the reference (data-parallel only, SURVEY.md
+section 2.3). GPipe-style schedule expressed SPMD: every device holds one
+stage's parameters; microbatches flow around the ring with ``ppermute``
+inside a ``lax.scan``. With M microbatches and S stages the schedule runs
+M + S - 1 ticks (the classic bubble).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(stage_params, microbatches, stage_fn, axis_name: str,
+                    n_microbatches: int):
+    """Runs on one device holding one stage (shard_map body).
+
+    stage_params: this stage's params (leading stage dim stripped by
+      shard_map).
+    microbatches: [M, ...] -- replicated on every stage (in_specs=P());
+      only stage 0 reads it to inject inputs. This costs S copies of the
+      microbatch buffer; acceptable because microbatches are inputs, not
+      the (large) inter-stage activations, which stay per-device.
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage_id = lax.axis_index(axis_name)
+    # shard_map keeps the sharded leading stage dim as size 1; strip it
+    stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    total_ticks = n_microbatches + n_stages - 1
+    mb_shape = microbatches.shape[1:]
+
+    state = jnp.zeros(mb_shape, microbatches.dtype)  # current activation
+    outputs = jnp.zeros((n_microbatches,) + mb_shape, microbatches.dtype)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 injects microbatch t (if any remain); others use incoming
+        inject = microbatches[jnp.minimum(t, n_microbatches - 1)]
+        x = jnp.where(stage_id == 0,
+                      jnp.where(t < n_microbatches, inject,
+                                jnp.zeros_like(inject)),
+                      state)
+        y = stage_fn(stage_params, x)
+        # last stage records its finished microbatch (t - (S-1))
+        out_idx = t - (n_stages - 1)
+        record = jnp.logical_and(stage_id == n_stages - 1, out_idx >= 0)
+        outputs = lax.cond(
+            record,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(out_idx, 0), 0),
+            lambda o: o, outputs)
+        # pass activation to the next stage (ring; wraparound ignored)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (state, outputs),
+                               jnp.arange(total_ticks))
+    # only the last stage recorded anything; psum replicates its buffer
+    # (other stages contribute zeros) so out_specs=P() is truthful.
+    return lax.psum(outputs, axis_name)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                   stacked_params: Any, microbatches: jnp.ndarray,
+                   mesh: Mesh, axis_name: str = "pipe") -> jnp.ndarray:
+    """Run ``stage_fn`` as an S-stage pipeline over the ``axis_name`` axis.
+
+    Args:
+      stage_fn: (stage_params, activation [*mb_shape]) -> activation; must
+        preserve the activation shape/dtype between stages.
+      stacked_params: pytree whose leaves have leading dim S (one slice per
+        stage) -- sharded so each device gets its stage.
+      microbatches: [M, *mb_shape] microbatch activations.
+      mesh: mesh with a pipeline axis of size S.
+
+    Returns [M, *mb_shape]: outputs of the final stage per microbatch.
+    """
+    n_microbatches = microbatches.shape[0]
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stacked_params)
+    fn = jax.shard_map(
+        partial(_pipeline_local, stage_fn=stage_fn, axis_name=axis_name,
+                n_microbatches=n_microbatches),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False)
+    return fn(stacked_params, microbatches)

@@ -1,0 +1,139 @@
+"""Ring attention: exact sequence-parallel attention over a device ring.
+
+New capability relative to the reference, which has *no* long-context or
+sequence-parallel support (SURVEY.md section 5: "Long-context / sequence
+parallelism: Absent"; its TransformerLayer/BERT use full O(L^2) attention
+on one device, ref: zoo/.../keras/layers/TransformerLayer.scala).
+
+Design (blockwise online-softmax, Liu et al. ring attention):
+- Q, K, V are sharded along the sequence axis of the mesh; each device
+  holds one block of queries and one block of keys/values.
+- N ring steps: each device computes flash-style partial attention of its
+  Q block against the resident K/V block while ``ppermute``-ing K/V to the
+  next device -- comm overlaps compute on TPU (ICI is bidirectional).
+- Running (max, sum, acc) accumulators give the exact softmax; causal
+  masking uses global position offsets derived from the ring step.
+
+The inner block kernel is plain jnp (XLA fuses it well on TPU); swap-in of
+the Pallas flash kernel for the intra-block computation happens in
+``analytics_zoo_tpu.ops`` when block sizes warrant it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias, q_offset, kv_offset, causal, scale,
+                m_prev, l_prev, o_prev):
+    """One flash-attention block update with online softmax.
+
+    q: [B, Lq, H, D]; k, v: [B, Lkv, H, D]; accumulators carry the running
+    max ``m``, normalizer ``l`` and unnormalized output ``o``.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
+        k_pos = kv_offset + jnp.arange(k.shape[1])[None, :]
+        mask = q_pos >= k_pos  # [Lq, Lkv]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+
+    m_cur = jnp.max(s, axis=-1)                      # [B, H, Lq]
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (all NEG_INF): exp underflows to 0 safely
+    p = jnp.exp(s - m_new[..., None])                # [B, H, Lq, Lkv]
+    l_corr = jnp.exp(m_prev - m_new)
+    l_new = l_corr * l_prev + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    o_new = o_prev * l_corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _ring_attn_local(q, k, v, axis_name: str, causal: bool,
+                     scale: Optional[float]):
+    """Per-device body, runs under shard_map with seq-sharded q/k/v."""
+    n_dev = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lkv = k.shape[1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+
+    q32 = q.astype(jnp.float32)
+    m = jnp.full((b, h, lq), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((b, h, lq), dtype=jnp.float32)
+    o = jnp.zeros((b, lq, h, d), dtype=jnp.float32)
+    q_offset = idx * lq
+
+    def step(carry, i):
+        m, l, o, k_blk, v_blk = carry
+        # K/V block currently resident came from device (idx - i) mod n
+        kv_owner = (idx - i) % n_dev
+        kv_offset = kv_owner * lkv
+        m, l, o = _block_attn(q32, k_blk.astype(jnp.float32),
+                              v_blk.astype(jnp.float32), None,
+                              q_offset, kv_offset, causal, scale, m, l, o)
+        perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (m, l, o, k_nxt, v_nxt), None
+
+    (m, l, o, _, _), _ = lax.scan(step, (m, l, o, k, v),
+                                  jnp.arange(n_dev))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
+                   causal: bool = False, scale: Optional[float] = None,
+                   qkv_spec: Optional[P] = None):
+    """Exact attention with sequence dim sharded over ``axis_name``.
+
+    Args:
+      q, k, v: [batch, seq, heads, head_dim] (global arrays or to-be-sharded
+        host arrays; seq must divide by the axis size).
+      mesh: mesh containing ``axis_name``.
+      causal: apply causal masking using global positions.
+      qkv_spec: PartitionSpec for q/k/v; default shards batch over 'data'
+        (if present in the mesh) and seq over ``axis_name``.
+    """
+    if qkv_spec is None:
+        data = "data" if "data" in mesh.axis_names else None
+        qkv_spec = P(data, axis_name, None, None)
+    fn = jax.shard_map(
+        partial(_ring_attn_local, axis_name=axis_name, causal=causal,
+                scale=scale),
+        mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def ring_self_attention(x, wq, wk, wv, wo, num_heads: int, mesh: Mesh,
+                        axis_name: str = "seq", causal: bool = False):
+    """Convenience: project -> ring attention -> output projection.
+
+    x: [batch, seq, dim]; w*: [dim, dim]. Projections are local (sequence
+    dim untouched), so only the attention itself communicates.
+    """
+    b, s, dim = x.shape
+    head_dim = dim // num_heads
+
+    def proj(w):
+        return jnp.einsum("bsd,de->bse", x, w).reshape(b, s, num_heads,
+                                                       head_dim)
+
+    out = ring_attention(proj(wq), proj(wk), proj(wv), mesh,
+                         axis_name=axis_name, causal=causal)
+    out = out.reshape(b, s, dim)
+    return jnp.einsum("bsd,de->bse", out, wo)

@@ -23,13 +23,17 @@ Readback (``read_events``) supports the Estimator's
 
 from __future__ import annotations
 
+import itertools
 import os
+import socket
 import struct
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
+
+_WRITER_COUNTER = itertools.count()
 
 # ---------------------------------------------------------------- crc32c ---
 
@@ -104,18 +108,25 @@ def _enc_packed_doubles(field: int, vs) -> bytes:
     return _enc_bytes(field, payload)
 
 
-def _encode_histogram(values: np.ndarray) -> bytes:
-    """HistogramProto from raw values, TF-style exponential buckets."""
-    values = np.asarray(values, dtype=np.float64).ravel()
-    if values.size == 0:
-        values = np.zeros(1)
+def _make_bucket_limits() -> np.ndarray:
     limits: List[float] = []
     v = 1e-12
     while v < 1e20:
         limits.append(v)
         v *= 1.1
     limits = sorted([-x for x in limits]) + limits + [1.7976931348623157e308]
-    bucket_limit = np.asarray(limits)
+    return np.asarray(limits)
+
+
+_BUCKET_LIMITS = _make_bucket_limits()
+
+
+def _encode_histogram(values: np.ndarray) -> bytes:
+    """HistogramProto from raw values, TF-style exponential buckets."""
+    values = np.asarray(values, dtype=np.float64).ravel()
+    if values.size == 0:
+        values = np.zeros(1)
+    bucket_limit = _BUCKET_LIMITS
     counts, _ = np.histogram(values, bins=np.concatenate(
         [[-1.7976931348623157e308], bucket_limit]))
     nz = counts.nonzero()[0]
@@ -208,7 +219,12 @@ class SummaryWriter:
     def __init__(self, log_dir: str, flush_every: int = 20):
         os.makedirs(log_dir, exist_ok=True)
         self.log_dir = log_dir
-        fname = f"events.out.tfevents.{int(time.time())}.analytics-zoo-tpu"
+        # hostname+pid uniquify the file so concurrent writers (train +
+        # validation, or multiple worker processes) never interleave
+        # partial records in one append-mode file.
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}.{os.getpid()}"
+                 f".{next(_WRITER_COUNTER)}.analytics-zoo-tpu")
         self._path = os.path.join(log_dir, fname)
         self._file = open(self._path, "ab")
         self._lock = threading.Lock()

@@ -1,0 +1,117 @@
+"""Tests for the unified SPMD parallelism layer.
+
+Runs the real collective code paths on the 8-device virtual CPU mesh --
+the analog of the reference testing DistriOptimizer on Spark local[N]
+(ref: zoo/src/test/scala/.../estimator/DistriEstimatorSpec.scala).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu.parallel import (
+    collectives,
+    create_mesh,
+    mesh_axis_size,
+    named_sharding,
+    pipeline_apply,
+    replicated,
+    ring_attention,
+    shard_batch,
+)
+
+
+class TestMesh:
+    def test_default_data_parallel(self):
+        mesh = create_mesh()
+        assert mesh.axis_names == ("data",)
+        assert mesh.devices.size == 8
+
+    def test_2d_mesh(self):
+        mesh = create_mesh({"data": 2, "model": 4})
+        assert mesh.axis_names == ("data", "model")
+        assert mesh_axis_size(mesh, "data") == 2
+        assert mesh_axis_size(mesh, "model") == 4
+        assert mesh_axis_size(mesh, "absent") == 1
+
+    def test_inferred_axis(self):
+        mesh = create_mesh({"data": -1, "model": 2})
+        assert mesh_axis_size(mesh, "data") == 4
+
+    def test_bad_mesh_raises(self):
+        with pytest.raises(ValueError):
+            create_mesh({"data": 3, "model": 3})
+
+
+class TestSharding:
+    def test_shard_batch_places_on_data_axis(self):
+        mesh = create_mesh()
+        batch = {"x": np.ones((16, 4), np.float32),
+                 "y": np.zeros((16,), np.int32)}
+        out = shard_batch(batch, mesh)
+        assert out["x"].sharding == named_sharding(mesh, "data", None)
+        assert out["y"].sharding == named_sharding(mesh, "data")
+
+    def test_replicated(self):
+        mesh = create_mesh()
+        x = jax.device_put(jnp.ones((3, 3)), replicated(mesh))
+        assert x.sharding.is_fully_replicated
+
+
+class TestCollectives:
+    def test_allreduce_matches_sum(self):
+        mesh = create_mesh()
+        x = jnp.arange(8.0)
+        f = jax.shard_map(
+            lambda t: collectives.all_reduce_sum(t, "data"),
+            mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+        np.testing.assert_allclose(np.asarray(f(x)), np.full(8, 28.0))
+
+    def test_global_norm(self):
+        tree = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+        assert float(collectives.global_norm(tree)) == pytest.approx(5.0)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        mesh = create_mesh({"data": 2, "seq": 4})
+        b, s, h, d = 2, 32, 4, 16
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+
+        out = ring_attention(q, k, v, mesh, axis_name="seq", causal=causal)
+
+        # dense reference
+        scale = 1.0 / np.sqrt(d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            mask = np.tril(np.ones((s, s), bool))
+            logits = jnp.where(mask[None, None], logits, -1e30)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+
+class TestPipeline:
+    def test_matches_sequential_stages(self):
+        mesh = create_mesh({"pipe": 8})
+        n_stages, n_micro, dim = 8, 4, 16
+        rng = np.random.RandomState(1)
+        ws = jnp.asarray(rng.randn(n_stages, dim, dim) * 0.3, jnp.float32)
+        mbs = jnp.asarray(rng.randn(n_micro, 2, dim), jnp.float32)
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        out = pipeline_apply(stage_fn, ws, mbs, mesh, axis_name="pipe")
+
+        ref = mbs
+        for i in range(n_stages):
+            ref = jnp.tanh(ref @ ws[i])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
