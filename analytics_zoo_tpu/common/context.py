@@ -154,15 +154,12 @@ def init_zoo_context(
                 kwargs["num_processes"] = num_processes
             if process_id is not None:
                 kwargs["process_id"] = process_id
-            try:
+            # a previous init attempt may have failed *after* this point;
+            # reuse the live distributed runtime rather than poisoning every
+            # future init (jax raises on double-initialize).
+            if not jax.distributed.is_initialized():
                 jax.distributed.initialize(**kwargs)
                 dist_started_here = True
-            except RuntimeError as e:
-                # already initialized (e.g. a previous attempt failed after
-                # this point): reuse the existing distributed runtime rather
-                # than poisoning every future init.
-                if "already initialized" not in str(e):
-                    raise
 
         config = get_config()
         if conf:

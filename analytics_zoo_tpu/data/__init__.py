@@ -1,0 +1,24 @@
+"""Data layer: sharded datasets feeding the SPMD training engine.
+
+The analog of the reference's three data stacks (SURVEY.md L2):
+- ``TFDataset``   (pyzoo/zoo/tfpark/tf_dataset.py)  -> :class:`ZooDataset`
+- ``XShards``     (pyzoo/zoo/orca/data/shard.py)    -> :class:`XShards`
+- ``FeatureSet``  (zoo/.../feature/FeatureSet.scala) -> memory-tier caching
+  on :class:`ZooDataset` (DRAM / DISK_AND_DRAM via memmap; the PMEM tier's
+  role -- datasets bigger than RAM -- is served by the disk tier).
+
+One abstraction instead of three: a ZooDataset yields *global* batches as
+host numpy, and the engine places them onto the mesh (`shard_batch`).
+Per-host sharding for multi-host runs happens at iteration time, mirroring
+how TFDataset ships RDD partitions to executors.
+"""
+
+from analytics_zoo_tpu.data.shard import XShards  # noqa: F401
+from analytics_zoo_tpu.data.dataset import ZooDataset  # noqa: F401
+from analytics_zoo_tpu.data.sources import (  # noqa: F401
+    read_csv,
+    read_json,
+    read_parquet,
+    read_image_folder,
+    read_tfrecord,
+)

@@ -1,0 +1,277 @@
+"""ZooDataset: the training-facing sharded dataset.
+
+Analog of ``TFDataset`` (ref: pyzoo/zoo/tfpark/tf_dataset.py:115-1279) +
+``FeatureSet`` memory tiers (ref: zoo/.../feature/FeatureSet.scala:644-683).
+
+Contracts carried over from the reference:
+- global batch size must divide evenly over the parallel workers
+  (ref: tf_dataset.py:142-147 enforces ``batch_size % total_cores == 0``);
+  here: over the mesh's data-axis size, checked in :meth:`batches`.
+- datasets can be cached in DRAM or spilled to disk
+  (``memory_type="DRAM" | "DISK"``; the reference's PMEM tier serves the
+  same larger-than-RAM role, ref: FeatureSet.scala memoryType).
+- deterministic epoch shuffling with a seed, sequential order optional
+  (ref: FeatureSet ``sequentialOrder``/``shuffle`` flags).
+
+Yields *host-local* numpy batches; ``device_iterator`` additionally places
+them on the mesh (sharded along the data axis) with one-batch lookahead so
+host->HBM transfer overlaps the train step.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import tempfile
+import threading
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def _tree_map(fn, tree):
+    return jax.tree_util.tree_map(fn, tree)
+
+
+def _leading_dim(tree) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        raise ValueError("empty pytree")
+    n = leaves[0].shape[0]
+    for l in leaves:
+        if l.shape[0] != n:
+            raise ValueError("all arrays must share the leading dim")
+    return n
+
+
+def _spill_to_disk(tree, cache_dir: str):
+    """Replace each array with a read-only memmap backed by ``cache_dir``."""
+    os.makedirs(cache_dir, exist_ok=True)
+    counter = [0]
+
+    def spill(x):
+        x = np.asarray(x)
+        path = os.path.join(cache_dir, f"arr_{counter[0]}.npy")
+        counter[0] += 1
+        np.save(path, x)
+        return np.load(path, mmap_mode="r")
+
+    return _tree_map(spill, tree)
+
+
+class ZooDataset:
+    """An in-memory (or disk-tiered) dataset of features + optional labels.
+
+    ``features`` / ``labels`` are pytrees (array, dict, or tuple of arrays)
+    sharing a leading sample dimension.
+    """
+
+    def __init__(self, features: Any, labels: Any = None,
+                 memory_type: str = "DRAM",
+                 cache_dir: Optional[str] = None):
+        memory_type = memory_type.upper()
+        if memory_type not in ("DRAM", "DISK"):
+            raise ValueError(
+                f"memory_type must be DRAM or DISK, got {memory_type!r}")
+        features = _tree_map(np.asarray, features)
+        labels = _tree_map(np.asarray, labels) if labels is not None else None
+        self._n = _leading_dim(features)
+        if labels is not None and _leading_dim(labels) != self._n:
+            raise ValueError("features and labels disagree on sample count")
+        if memory_type == "DISK":
+            cache_dir = cache_dir or tempfile.mkdtemp(prefix="zoo_dataset_")
+            features = _spill_to_disk(features, os.path.join(cache_dir, "x"))
+            if labels is not None:
+                labels = _spill_to_disk(labels, os.path.join(cache_dir, "y"))
+            logger.info("dataset spilled to disk tier at %s", cache_dir)
+        self.features = features
+        self.labels = labels
+        self.memory_type = memory_type
+
+    # ----------------------------------------------------- constructors --
+    @staticmethod
+    def from_ndarrays(features: Any, labels: Any = None,
+                      **kwargs) -> "ZooDataset":
+        """Mirror of ``TFDataset.from_ndarrays`` (ref: tf_dataset.py:322)."""
+        return ZooDataset(features, labels, **kwargs)
+
+    @staticmethod
+    def from_xshards(shards, feature_cols=None, label_cols=None,
+                     **kwargs) -> "ZooDataset":
+        """Build from an XShards of dicts / DataFrames
+        (ref: orca Estimator fit accepting SparkXShards)."""
+        import pandas as pd
+
+        merged = shards.merged()
+        if isinstance(merged, pd.DataFrame):
+            if feature_cols is None:
+                raise ValueError("feature_cols required for DataFrame shards")
+            feats = {c: merged[c].to_numpy() for c in feature_cols}
+            labels = ({c: merged[c].to_numpy() for c in label_cols}
+                      if label_cols else None)
+            if labels is not None and len(labels) == 1:
+                labels = next(iter(labels.values()))
+            return ZooDataset(feats, labels, **kwargs)
+        if isinstance(merged, dict):
+            if feature_cols is None and "x" in merged:
+                feats = merged["x"]
+                labels = merged.get("y")
+            else:
+                feature_cols = feature_cols or list(merged.keys())
+                feats = {c: merged[c] for c in feature_cols}
+                labels = ({c: merged[c] for c in label_cols}
+                          if label_cols else None)
+            return ZooDataset(feats, labels, **kwargs)
+        return ZooDataset(merged, **kwargs)
+
+    # ----------------------------------------------------------- queries --
+    @property
+    def num_samples(self) -> int:
+        return self._n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def split(self, fraction: float, seed: int = 0
+              ) -> Tuple["ZooDataset", "ZooDataset"]:
+        """Random split into (first, second) with ``fraction`` in first."""
+        rng = np.random.RandomState(seed)
+        perm = rng.permutation(self._n)
+        cut = int(self._n * fraction)
+        first, second = perm[:cut], perm[cut:]
+
+        def take(tree, idx):
+            return _tree_map(lambda a: np.asarray(a)[idx], tree)
+
+        return (
+            ZooDataset(take(self.features, first),
+                       take(self.labels, first) if self.labels is not None
+                       else None),
+            ZooDataset(take(self.features, second),
+                       take(self.labels, second) if self.labels is not None
+                       else None),
+        )
+
+    def map_features(self, fn: Callable) -> "ZooDataset":
+        return ZooDataset(fn(self.features), self.labels)
+
+    # --------------------------------------------------------- iteration --
+    def steps_per_epoch(self, batch_size: int,
+                        drop_remainder: bool = True) -> int:
+        if drop_remainder:
+            return self._n // batch_size
+        return -(-self._n // batch_size)
+
+    def batches(self, batch_size: int, shuffle: bool = True, seed: int = 0,
+                epoch: int = 0, drop_remainder: bool = True,
+                mesh=None) -> Iterator[Tuple[Any, Any]]:
+        """Yield host-local numpy ``(features, labels)`` batches.
+
+        ``batch_size`` is the GLOBAL batch size; it must divide by the
+        mesh's data-axis size (ref contract: tf_dataset.py:142-147). On a
+        multi-process run, each process yields its 1/num_processes slice of
+        every global batch (samples strided by process index).
+
+        With ``drop_remainder=False`` the final short batch is padded up to
+        ``batch_size`` by wrapping to the epoch's first samples, keeping
+        every batch shape static for XLA and divisible for sharding
+        (predict paths truncate outputs back to ``num_samples``).
+        """
+        n_data = 1
+        if mesh is not None:
+            from analytics_zoo_tpu.parallel.mesh import mesh_axis_size
+
+            n_data = mesh_axis_size(mesh, "data")
+        if batch_size % max(n_data, 1) != 0:
+            raise ValueError(
+                f"global batch_size {batch_size} must be divisible by the "
+                f"data-parallel degree {n_data} "
+                "(ref contract: tf_dataset.py:142-147)")
+
+        n_proc = jax.process_count()
+        proc = jax.process_index()
+        if batch_size % n_proc != 0:
+            raise ValueError(
+                f"global batch_size {batch_size} must divide over "
+                f"{n_proc} processes")
+        local_bs = batch_size // n_proc
+
+        if shuffle:
+            rng = np.random.RandomState((seed * 100003 + epoch) & 0x7FFFFFFF)
+            order = rng.permutation(self._n)
+        else:
+            order = np.arange(self._n)
+
+        n_batches = self.steps_per_epoch(batch_size, drop_remainder)
+        for b in range(n_batches):
+            global_idx = order[b * batch_size:(b + 1) * batch_size]
+            if len(global_idx) < batch_size:  # pad final short batch
+                pad = order[:batch_size - len(global_idx)]
+                global_idx = np.concatenate([global_idx, pad])
+            local_idx = global_idx[proc::n_proc][:local_bs]
+            x = _tree_map(lambda a: np.asarray(a[local_idx]), self.features)
+            y = (_tree_map(lambda a: np.asarray(a[local_idx]), self.labels)
+                 if self.labels is not None else None)
+            yield x, y
+
+    def device_iterator(self, batch_size: int, mesh=None, shuffle: bool = True,
+                        seed: int = 0, epoch: int = 0,
+                        drop_remainder: bool = True,
+                        prefetch: int = 2) -> Iterator[Tuple[Any, Any]]:
+        """``batches`` + mesh placement + background prefetch.
+
+        A producer thread stages the next ``prefetch`` device batches while
+        the consumer runs the train step -- the analog of FeatureSet's
+        cached-RDD prefetch, but across the host->HBM boundary.
+        """
+        from analytics_zoo_tpu.parallel.mesh import default_mesh
+        from analytics_zoo_tpu.parallel.sharding import shard_batch
+
+        mesh = mesh or default_mesh()
+        q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        _SENTINEL = object()
+        err: list = []
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that gives up if the consumer went away, so an
+            # abandoned iterator never leaks a blocked thread pinning
+            # device batches in HBM
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for x, y in self.batches(batch_size, shuffle, seed, epoch,
+                                         drop_remainder, mesh):
+                    xd = shard_batch(x, mesh)
+                    yd = shard_batch(y, mesh) if y is not None else None
+                    if not put((xd, yd)):
+                        return
+            except BaseException as e:  # surface in consumer
+                err.append(e)
+            finally:
+                put(_SENTINEL)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
