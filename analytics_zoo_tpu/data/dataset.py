@@ -49,6 +49,28 @@ def _leading_dim(tree) -> int:
     return n
 
 
+def _take_chunked(tree, idx, memory_type: str, chunk: int = 65536):
+    """Index-select rows from a pytree; DISK tier streams through a new
+    memmap in chunks so selection never materializes fully in RAM."""
+    if memory_type != "DISK":
+        return _tree_map(lambda a: np.asarray(a)[idx], tree)
+    cache_dir = tempfile.mkdtemp(prefix="zoo_split_")
+    counter = [0]
+
+    def take(a):
+        path = os.path.join(cache_dir, f"arr_{counter[0]}.npy")
+        counter[0] += 1
+        out = np.lib.format.open_memmap(
+            path, mode="w+", dtype=a.dtype, shape=(len(idx),) + a.shape[1:])
+        for s in range(0, len(idx), chunk):
+            sel = idx[s:s + chunk]
+            out[s:s + len(sel)] = a[sel]
+        out.flush()
+        return np.load(path, mmap_mode="r")
+
+    return _tree_map(take, tree)
+
+
 def _spill_to_disk(tree, cache_dir: str):
     """Replace each array with a read-only memmap backed by ``cache_dir``."""
     os.makedirs(cache_dir, exist_ok=True)
@@ -126,6 +148,8 @@ class ZooDataset:
                 feats = {c: merged[c] for c in feature_cols}
                 labels = ({c: merged[c] for c in label_cols}
                           if label_cols else None)
+                if labels is not None and len(labels) == 1:
+                    labels = next(iter(labels.values()))
             return ZooDataset(feats, labels, **kwargs)
         return ZooDataset(merged, **kwargs)
 
@@ -139,23 +163,26 @@ class ZooDataset:
 
     def split(self, fraction: float, seed: int = 0
               ) -> Tuple["ZooDataset", "ZooDataset"]:
-        """Random split into (first, second) with ``fraction`` in first."""
+        """Random split into (first, second) with ``fraction`` in first.
+        Children inherit the memory tier; DISK-tier data is copied in
+        chunks so a larger-than-RAM dataset never fully materializes."""
         rng = np.random.RandomState(seed)
         perm = rng.permutation(self._n)
         cut = int(self._n * fraction)
         first, second = perm[:cut], perm[cut:]
 
-        def take(tree, idx):
-            return _tree_map(lambda a: np.asarray(a)[idx], tree)
+        def make(idx):
+            feats = _take_chunked(self.features, idx, self.memory_type)
+            labs = (_take_chunked(self.labels, idx, self.memory_type)
+                    if self.labels is not None else None)
+            # _take_chunked already produced disk-backed memmaps for the
+            # DISK tier; construct as DRAM to avoid a second spill copy,
+            # then restore the tier label.
+            child = ZooDataset(feats, labs)
+            child.memory_type = self.memory_type
+            return child
 
-        return (
-            ZooDataset(take(self.features, first),
-                       take(self.labels, first) if self.labels is not None
-                       else None),
-            ZooDataset(take(self.features, second),
-                       take(self.labels, second) if self.labels is not None
-                       else None),
-        )
+        return make(first), make(second)
 
     def map_features(self, fn: Callable) -> "ZooDataset":
         return ZooDataset(fn(self.features), self.labels)
@@ -169,7 +196,8 @@ class ZooDataset:
 
     def batches(self, batch_size: int, shuffle: bool = True, seed: int = 0,
                 epoch: int = 0, drop_remainder: bool = True,
-                mesh=None) -> Iterator[Tuple[Any, Any]]:
+                mesh=None, with_mask: bool = False
+                ) -> Iterator[Tuple[Any, ...]]:
         """Yield host-local numpy ``(features, labels)`` batches.
 
         ``batch_size`` is the GLOBAL batch size; it must divide by the
@@ -178,9 +206,12 @@ class ZooDataset:
         every global batch (samples strided by process index).
 
         With ``drop_remainder=False`` the final short batch is padded up to
-        ``batch_size`` by wrapping to the epoch's first samples, keeping
+        ``batch_size`` by wrapping (tiling) the epoch's samples, keeping
         every batch shape static for XLA and divisible for sharding
-        (predict paths truncate outputs back to ``num_samples``).
+        (predict paths truncate outputs back to ``num_samples``). With
+        ``with_mask=True`` each yield is ``(x, y, mask)`` where ``mask``
+        is a local float32 [local_bs] vector with 0 marking padded rows --
+        used by evaluate for exact tail-inclusive metrics.
         """
         n_data = 1
         if mesh is not None:
@@ -210,19 +241,25 @@ class ZooDataset:
         n_batches = self.steps_per_epoch(batch_size, drop_remainder)
         for b in range(n_batches):
             global_idx = order[b * batch_size:(b + 1) * batch_size]
-            if len(global_idx) < batch_size:  # pad final short batch
-                pad = order[:batch_size - len(global_idx)]
+            n_valid = len(global_idx)
+            if n_valid < batch_size:  # pad final short batch (tiled wrap)
+                pad = np.resize(order, batch_size - n_valid)
                 global_idx = np.concatenate([global_idx, pad])
-            local_idx = global_idx[proc::n_proc][:local_bs]
+            local_positions = np.arange(batch_size)[proc::n_proc][:local_bs]
+            local_idx = global_idx[local_positions]
             x = _tree_map(lambda a: np.asarray(a[local_idx]), self.features)
             y = (_tree_map(lambda a: np.asarray(a[local_idx]), self.labels)
                  if self.labels is not None else None)
-            yield x, y
+            if with_mask:
+                mask = (local_positions < n_valid).astype(np.float32)
+                yield x, y, mask
+            else:
+                yield x, y
 
     def device_iterator(self, batch_size: int, mesh=None, shuffle: bool = True,
                         seed: int = 0, epoch: int = 0,
-                        drop_remainder: bool = True,
-                        prefetch: int = 2) -> Iterator[Tuple[Any, Any]]:
+                        drop_remainder: bool = True, with_mask: bool = False,
+                        prefetch: int = 2) -> Iterator[Tuple[Any, ...]]:
         """``batches`` + mesh placement + background prefetch.
 
         A producer thread stages the next ``prefetch`` device batches while
@@ -252,11 +289,13 @@ class ZooDataset:
 
         def produce():
             try:
-                for x, y in self.batches(batch_size, shuffle, seed, epoch,
-                                         drop_remainder, mesh):
-                    xd = shard_batch(x, mesh)
-                    yd = shard_batch(y, mesh) if y is not None else None
-                    if not put((xd, yd)):
+                for item in self.batches(batch_size, shuffle, seed, epoch,
+                                         drop_remainder, mesh,
+                                         with_mask=with_mask):
+                    placed = tuple(
+                        shard_batch(part, mesh) if part is not None else None
+                        for part in item)
+                    if not put(placed):
                         return
             except BaseException as e:  # surface in consumer
                 err.append(e)

@@ -1,0 +1,21 @@
+"""Training/inference engine: the unified Estimator.
+
+Replaces the reference's L4/L5 training surface (SURVEY.md):
+InternalDistriOptimizer, zoo Estimator, TFPark TFOptimizer, and the Orca
+Estimators over five backends -- with one SPMD Estimator.
+"""
+
+from analytics_zoo_tpu.learn.estimator import Estimator  # noqa: F401
+from analytics_zoo_tpu.learn import metrics  # noqa: F401
+from analytics_zoo_tpu.learn import objectives  # noqa: F401
+from analytics_zoo_tpu.learn.optim import (  # noqa: F401
+    SGD,
+    Adam,
+    AdamWeightDecay,
+    RMSprop,
+    Adagrad,
+    Adadelta,
+    Fixed,
+    Poly,
+    Warmup,
+)

@@ -1,0 +1,100 @@
+"""Checkpoint save/restore.
+
+The analog of BigDL-format snapshots ``model.<iter>`` +
+``optimMethod-<name>.<iter>`` written into timestamped dirs on a
+checkpoint trigger (ref: zoo/.../keras/models/Topology.scala:1246-1252,
+NNEstimator.scala:464-470) and of ``TFOptimizer.load_checkpoint``
+(ref: pyzoo/zoo/tfpark/tf_optimizer.py:398-411).
+
+Format: ``<dir>/model.<step>`` and ``<dir>/optim.<step>`` are flax
+msgpack-serialized pytrees; ``<dir>/meta.<step>.json`` carries counters;
+``<dir>/latest`` names the newest step. Multi-process runs write from
+process 0 only and barrier afterwards.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from flax import serialization
+
+from analytics_zoo_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def save_checkpoint(ckpt_dir: str, variables: Any, opt_state: Any,
+                    step: int, epoch: int,
+                    extra_meta: Optional[Dict] = None) -> str:
+    """Write a snapshot; returns the checkpoint path prefix."""
+    if jax.process_index() == 0:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        host_vars = jax.device_get(variables)
+        host_opt = jax.device_get(opt_state)
+        _atomic_write(os.path.join(ckpt_dir, f"model.{step}"),
+                      serialization.to_bytes(host_vars))
+        _atomic_write(os.path.join(ckpt_dir, f"optim.{step}"),
+                      serialization.to_bytes(host_opt))
+        meta = {"step": int(step), "epoch": int(epoch)}
+        if extra_meta:
+            meta.update(extra_meta)
+        _atomic_write(os.path.join(ckpt_dir, f"meta.{step}.json"),
+                      json.dumps(meta).encode())
+        _atomic_write(os.path.join(ckpt_dir, "latest"), str(step).encode())
+        logger.info("checkpoint saved: %s step=%d", ckpt_dir, step)
+    _barrier()
+    return os.path.join(ckpt_dir, f"model.{step}")
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    path = os.path.join(ckpt_dir, "latest")
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        return int(f.read().strip())
+
+
+def load_checkpoint(ckpt_dir: str, variables_template: Any,
+                    opt_state_template: Any,
+                    step: Optional[int] = None
+                    ) -> Tuple[Any, Any, Dict]:
+    """Restore (variables, opt_state, meta); templates supply the pytree
+    structure (flax msgpack is structure-less on disk)."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
+    with open(os.path.join(ckpt_dir, f"model.{step}"), "rb") as f:
+        variables = serialization.from_bytes(
+            jax.device_get(variables_template), f.read())
+    with open(os.path.join(ckpt_dir, f"optim.{step}"), "rb") as f:
+        try:
+            opt_state = serialization.from_bytes(
+                jax.device_get(opt_state_template), f.read())
+        except ValueError as e:
+            raise ValueError(
+                "optimizer state in the checkpoint does not match this "
+                "Estimator's optimizer config (optimizer type and "
+                "clip_norm/clip_value must match the run that saved it): "
+                f"{e}") from e
+    with open(os.path.join(ckpt_dir, f"meta.{step}.json")) as f:
+        meta = json.load(f)
+    logger.info("checkpoint restored: %s step=%d", ckpt_dir, step)
+    return variables, opt_state, meta
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def _barrier() -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("zoo_checkpoint")

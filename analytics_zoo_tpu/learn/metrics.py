@@ -1,0 +1,223 @@
+"""Validation metrics, usable inside jitted eval steps.
+
+The analog of BigDL ``ValidationMethod``s surfaced by the reference
+(Accuracy/Top1/Top5/AUC/MAE/MSE/Loss -- ref: zoo/.../keras/metrics/,
+pyzoo/zoo/orca/learn/metrics.py, and the TF-tensor-backed
+``TFValidationMethod``/``StatelessMetric`` of tf_optimizer.py:45-66).
+
+Each metric is a pure state machine: ``empty()`` -> state pytree,
+``update(state, preds, labels)`` -> state (jit-safe), ``result(state)``
+-> scalar. The Estimator merges states across batches; cross-device
+reduction is free because updates run on globally-sharded arrays under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class Metric:
+    name: str = "metric"
+    # True if larger is better (used to pick "best" checkpoints and by
+    # MaxScore triggers)
+    greater_is_better: bool = True
+
+    def empty(self) -> Any:
+        raise NotImplementedError
+
+    def update(self, state: Any, preds, labels, weights=None) -> Any:
+        """``weights`` is an optional [B] 0/1 mask excluding padded
+        samples (short final batches are padded for static shapes)."""
+        raise NotImplementedError
+
+    def result(self, state: Any):
+        raise NotImplementedError
+
+
+def _ones_like_batch(preds):
+    n = jax.tree_util.tree_leaves(preds)[0].shape[0]
+    return jnp.ones((n,), jnp.float32)
+
+
+class _MeanMetric(Metric):
+    """Streaming weighted mean of a per-sample statistic."""
+
+    def empty(self):
+        return {"total": jnp.zeros((), jnp.float32),
+                "count": jnp.zeros((), jnp.float32)}
+
+    def _per_sample(self, preds, labels):
+        """Return a [B] float statistic, one value per sample."""
+        raise NotImplementedError
+
+    def update(self, state, preds, labels, weights=None):
+        stat = self._per_sample(preds, labels)
+        w = (_ones_like_batch(preds) if weights is None
+             else jnp.asarray(weights, jnp.float32))
+        return {"total": state["total"] + jnp.sum(stat * w),
+                "count": state["count"] + jnp.sum(w)}
+
+    def result(self, state):
+        return state["total"] / jnp.maximum(state["count"], 1.0)
+
+
+class Accuracy(_MeanMetric):
+    """Sparse top-1 accuracy; handles [B,C] logits/probs, [B] binary
+    scores, or hard predictions (ref: keras/metrics/Accuracy)."""
+
+    name = "accuracy"
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def _per_sample(self, preds, labels):
+        labels = jnp.asarray(labels)
+        if labels.ndim == preds.ndim and labels.shape[-1] > 1:
+            labels = jnp.argmax(labels, -1)  # one-hot -> sparse
+        labels = labels.reshape(labels.shape[0], -1)[:, 0]
+        if preds.ndim > 1 and preds.shape[-1] > 1:
+            hard = jnp.argmax(preds, -1).reshape(preds.shape[0], -1)[:, 0]
+        else:
+            flat = preds.reshape(preds.shape[0], -1)[:, 0]
+            hard = (flat > self.threshold).astype(jnp.int32)
+        return (hard == labels.astype(hard.dtype)).astype(jnp.float32)
+
+
+Top1Accuracy = Accuracy
+
+
+class TopKAccuracy(_MeanMetric):
+    def __init__(self, k: int = 5):
+        self.k = k
+        self.name = f"top{k}_accuracy"
+
+    def _per_sample(self, preds, labels):
+        labels = jnp.asarray(labels).reshape(-1)
+        topk = jnp.argsort(preds, -1)[:, -self.k:]
+        return jnp.any(topk == labels[:, None], axis=-1).astype(jnp.float32)
+
+
+def Top5Accuracy():
+    return TopKAccuracy(5)
+
+
+class MAE(_MeanMetric):
+    name = "mae"
+    greater_is_better = False
+
+    def _per_sample(self, preds, labels):
+        preds = preds.reshape(preds.shape[0], -1)
+        labels = jnp.asarray(labels).reshape(labels.shape[0], -1)
+        return jnp.mean(jnp.abs(preds - labels), axis=-1)
+
+
+class MSE(_MeanMetric):
+    name = "mse"
+    greater_is_better = False
+
+    def _per_sample(self, preds, labels):
+        preds = preds.reshape(preds.shape[0], -1)
+        labels = jnp.asarray(labels).reshape(labels.shape[0], -1)
+        return jnp.mean(jnp.square(preds - labels), axis=-1)
+
+
+class RMSE(MSE):
+    name = "rmse"
+
+    def result(self, state):
+        return jnp.sqrt(super().result(state))
+
+
+class Loss(_MeanMetric):
+    """Mean of a loss function over the eval set. The loss fn returns a
+    batch mean, so per-sample values come from vmapping over singleton
+    batches (keeps padding-masked eval exact)."""
+
+    name = "loss"
+    greater_is_better = False
+
+    def __init__(self, loss_fn):
+        self.loss_fn = loss_fn
+
+    def _per_sample(self, preds, labels):
+        def one(p, t):
+            return self.loss_fn(
+                jax.tree_util.tree_map(lambda a: a[None], p),
+                jax.tree_util.tree_map(lambda a: a[None], t))
+
+        return jax.vmap(one)(preds, labels)
+
+
+class AUC(Metric):
+    """Streaming ROC-AUC via fixed-threshold TP/FP histograms, the same
+    binned estimator TF/Keras uses (ref: keras/metrics AUC)."""
+
+    name = "auc"
+
+    def __init__(self, num_thresholds: int = 200):
+        self.num_thresholds = num_thresholds
+
+    def empty(self):
+        z = jnp.zeros((self.num_thresholds,), jnp.float32)
+        return {"tp": z, "fp": z, "tn": z, "fn": z}
+
+    def update(self, state, preds, labels, weights=None):
+        scores = jnp.asarray(preds).reshape(-1)
+        y = jnp.asarray(labels).reshape(-1).astype(jnp.float32)
+        w = (jnp.ones_like(scores) if weights is None
+             else jnp.asarray(weights, jnp.float32).reshape(-1))
+        eps = 1e-7
+        th = jnp.linspace(0.0 - eps, 1.0 + eps, self.num_thresholds)
+        pred_pos = (scores[None, :] > th[:, None]).astype(jnp.float32)
+        pos = (y[None, :] > 0.5).astype(jnp.float32)
+        return {
+            "tp": state["tp"] + jnp.sum(w * pred_pos * pos, -1),
+            "fp": state["fp"] + jnp.sum(w * pred_pos * (1 - pos), -1),
+            "fn": state["fn"] + jnp.sum(w * (1 - pred_pos) * pos, -1),
+            "tn": state["tn"] + jnp.sum(w * (1 - pred_pos) * (1 - pos), -1),
+        }
+
+    def result(self, state):
+        tpr = state["tp"] / jnp.maximum(state["tp"] + state["fn"], 1e-7)
+        fpr = state["fp"] / jnp.maximum(state["fp"] + state["tn"], 1e-7)
+        # thresholds ascend -> fpr/tpr descend; integrate with trapezoid
+        return jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
+
+
+class BinaryCrossEntropy(_MeanMetric):
+    name = "binary_crossentropy"
+    greater_is_better = False
+
+    def _per_sample(self, preds, labels):
+        p = jnp.clip(preds.reshape(preds.shape[0], -1), 1e-7, 1 - 1e-7)
+        y = jnp.asarray(labels).reshape(p.shape).astype(jnp.float32)
+        ll = y * jnp.log(p) + (1 - y) * jnp.log(1 - p)
+        return -jnp.mean(ll, axis=-1)
+
+
+_REGISTRY = {
+    "accuracy": Accuracy, "acc": Accuracy, "top1": Accuracy,
+    "top5": Top5Accuracy, "top5accuracy": Top5Accuracy,
+    "mae": MAE, "mse": MSE, "rmse": RMSE, "auc": AUC,
+    "binary_crossentropy": BinaryCrossEntropy,
+}
+
+
+def resolve_metric(m) -> Metric:
+    if isinstance(m, Metric):
+        return m
+    if isinstance(m, str):
+        key = m.lower().replace("_accuracy", "") if m.lower() in (
+            "top5_accuracy",) else m.lower()
+        if key in _REGISTRY:
+            return _REGISTRY[key]()
+        raise ValueError(f"unknown metric {m!r}")
+    if callable(m):
+        # assume a loss-like callable
+        metric = Loss(m)
+        metric.name = getattr(m, "__name__", "loss")
+        return metric
+    raise TypeError(f"cannot interpret metric {m!r}")

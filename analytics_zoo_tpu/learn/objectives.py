@@ -1,0 +1,139 @@
+"""Loss functions (objectives).
+
+The analog of the reference's 15 objectives
+(ref: zoo/.../pipeline/api/keras/objectives/ -- SparseCategoricalCrossEntropy,
+CategoricalCrossEntropy, BinaryCrossEntropy, MeanSquaredError,
+MeanAbsoluteError, MeanAbsolutePercentageError, MeanSquaredLogarithmicError,
+Hinge, SquaredHinge, Poisson, CosineProximity, KullbackLeiblerDivergence,
+RankHinge). Every loss is ``fn(preds, labels) -> scalar batch mean``;
+computed on globally-sharded batches under jit, so the mean is the global
+batch mean (matching BigDL's global-batch loss semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def sparse_categorical_crossentropy(preds, labels, from_logits: bool = True):
+    labels = jnp.asarray(labels).reshape(-1).astype(jnp.int32)
+    if from_logits:
+        logp = jax.nn.log_softmax(preds, -1)
+    else:
+        logp = jnp.log(jnp.clip(preds, _EPS, 1.0))
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+    return jnp.mean(nll)
+
+
+def categorical_crossentropy(preds, labels, from_logits: bool = True):
+    labels = jnp.asarray(labels, jnp.float32)
+    if from_logits:
+        logp = jax.nn.log_softmax(preds, -1)
+    else:
+        logp = jnp.log(jnp.clip(preds, _EPS, 1.0))
+    return -jnp.mean(jnp.sum(labels * logp, -1))
+
+
+def binary_crossentropy(preds, labels, from_logits: bool = False):
+    y = jnp.asarray(labels, jnp.float32).reshape(preds.shape)
+    if from_logits:
+        return jnp.mean(
+            jnp.maximum(preds, 0) - preds * y +
+            jnp.log1p(jnp.exp(-jnp.abs(preds))))
+    p = jnp.clip(preds, _EPS, 1 - _EPS)
+    return -jnp.mean(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+
+
+def mean_squared_error(preds, labels):
+    return jnp.mean(jnp.square(preds - jnp.asarray(
+        labels, preds.dtype).reshape(preds.shape)))
+
+
+def mean_absolute_error(preds, labels):
+    return jnp.mean(jnp.abs(preds - jnp.asarray(
+        labels, preds.dtype).reshape(preds.shape)))
+
+
+def mean_absolute_percentage_error(preds, labels):
+    y = jnp.asarray(labels, preds.dtype).reshape(preds.shape)
+    return 100.0 * jnp.mean(jnp.abs((y - preds) /
+                                    jnp.clip(jnp.abs(y), _EPS)))
+
+
+def mean_squared_logarithmic_error(preds, labels):
+    y = jnp.asarray(labels, preds.dtype).reshape(preds.shape)
+    return jnp.mean(jnp.square(jnp.log1p(jnp.clip(y, 0)) -
+                               jnp.log1p(jnp.clip(preds, 0))))
+
+
+def hinge(preds, labels):
+    y = jnp.asarray(labels, preds.dtype).reshape(preds.shape)
+    y = jnp.where(y > 0, 1.0, -1.0)
+    return jnp.mean(jnp.maximum(1.0 - y * preds, 0.0))
+
+
+def squared_hinge(preds, labels):
+    y = jnp.asarray(labels, preds.dtype).reshape(preds.shape)
+    y = jnp.where(y > 0, 1.0, -1.0)
+    return jnp.mean(jnp.square(jnp.maximum(1.0 - y * preds, 0.0)))
+
+
+def poisson(preds, labels):
+    y = jnp.asarray(labels, preds.dtype).reshape(preds.shape)
+    return jnp.mean(preds - y * jnp.log(preds + _EPS))
+
+
+def cosine_proximity(preds, labels):
+    y = jnp.asarray(labels, preds.dtype).reshape(preds.shape)
+    p = preds / jnp.maximum(jnp.linalg.norm(preds, axis=-1, keepdims=True),
+                            _EPS)
+    y = y / jnp.maximum(jnp.linalg.norm(y, axis=-1, keepdims=True), _EPS)
+    return -jnp.mean(jnp.sum(p * y, -1))
+
+
+def kullback_leibler_divergence(preds, labels):
+    y = jnp.clip(jnp.asarray(labels, preds.dtype).reshape(preds.shape),
+                 _EPS, 1.0)
+    p = jnp.clip(preds, _EPS, 1.0)
+    return jnp.mean(jnp.sum(y * jnp.log(y / p), -1))
+
+
+def rank_hinge(preds, labels, margin: float = 1.0):
+    """Pairwise ranking hinge for (pos, neg) pair batches: preds [2B] or
+    [B,2] with positives first (ref: objectives/RankHinge.scala used by
+    KNRM text matching)."""
+    flat = preds.reshape(-1)
+    pos, neg = flat[0::2], flat[1::2]
+    return jnp.mean(jnp.maximum(margin - pos + neg, 0.0))
+
+
+_REGISTRY = {
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "categorical_crossentropy": categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mse": mean_squared_error, "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error, "mean_absolute_error": mean_absolute_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "hinge": hinge, "squared_hinge": squared_hinge, "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "kld": kullback_leibler_divergence,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "rank_hinge": rank_hinge,
+}
+
+
+def resolve_loss(loss):
+    if callable(loss):
+        return loss
+    if isinstance(loss, str):
+        key = loss.lower()
+        if key in _REGISTRY:
+            return _REGISTRY[key]
+        raise ValueError(f"unknown loss {loss!r}")
+    raise TypeError(f"cannot interpret loss {loss!r}")

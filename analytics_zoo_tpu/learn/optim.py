@@ -1,0 +1,181 @@
+"""Optimizers and LR schedules.
+
+The analog of the reference's optimizer surface: BigDL OptimMethods exposed
+through the Keras API plus zoo's own ``Adam`` and BERT-style
+``AdamWeightDecay`` (ref: zoo/.../keras/optimizers/Adam.scala,
+AdamWeightDecay.scala) and the ``Optim.Fixed`` LR schedule
+(ref: zoo/.../common/Optim.scala:29). Backed by optax; each class is a
+thin declarative config whose ``to_optax()`` yields the
+GradientTransformation the Estimator chains with clipping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Union
+
+import optax
+
+ScheduleLike = Union[float, Callable[[Any], Any]]
+
+
+class LearningRateSchedule:
+    def to_optax(self) -> ScheduleLike:
+        raise NotImplementedError
+
+
+class Fixed(LearningRateSchedule):
+    """Constant LR (ref: Optim.Fixed, common/Optim.scala:29)."""
+
+    def __init__(self, lr: float):
+        self.lr = lr
+
+    def to_optax(self):
+        return self.lr
+
+
+class Poly(LearningRateSchedule):
+    """Polynomial decay to zero over ``max_iteration`` steps (BigDL Poly)."""
+
+    def __init__(self, power: float, max_iteration: int, lr: float):
+        self.power, self.max_iteration, self.lr = power, max_iteration, lr
+
+    def to_optax(self):
+        return optax.polynomial_schedule(
+            init_value=self.lr, end_value=0.0, power=self.power,
+            transition_steps=self.max_iteration)
+
+
+class Warmup(LearningRateSchedule):
+    """Linear warmup then constant / linear decay (the schedule baked into
+    the reference's AdamWeightDecay for BERT, ref: AdamWeightDecay.scala)."""
+
+    def __init__(self, lr: float, warmup_steps: int,
+                 total_steps: Optional[int] = None):
+        self.lr, self.warmup_steps, self.total_steps = (
+            lr, warmup_steps, total_steps)
+
+    def to_optax(self):
+        warm = optax.linear_schedule(0.0, self.lr, self.warmup_steps)
+        if self.total_steps is None:
+            return optax.join_schedules([warm, optax.constant_schedule(
+                self.lr)], [self.warmup_steps])
+        decay = optax.linear_schedule(
+            self.lr, 0.0, max(self.total_steps - self.warmup_steps, 1))
+        return optax.join_schedules([warm, decay], [self.warmup_steps])
+
+
+def _as_schedule(lr) -> ScheduleLike:
+    if isinstance(lr, LearningRateSchedule):
+        return lr.to_optax()
+    return lr
+
+
+class ZooOptimizer:
+    """Base optimizer config."""
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+
+class SGD(ZooOptimizer):
+    def __init__(self, lr: ScheduleLike = 0.01, momentum: float = 0.0,
+                 nesterov: bool = False, weight_decay: float = 0.0):
+        self.lr, self.momentum = lr, momentum
+        self.nesterov, self.weight_decay = nesterov, weight_decay
+
+    def to_optax(self):
+        tx = optax.sgd(_as_schedule(self.lr), momentum=self.momentum or None,
+                       nesterov=self.nesterov)
+        if self.weight_decay:
+            tx = optax.chain(optax.add_decayed_weights(self.weight_decay), tx)
+        return tx
+
+
+class Adam(ZooOptimizer):
+    """(ref: zoo/.../keras/optimizers/Adam.scala)."""
+
+    def __init__(self, lr: ScheduleLike = 1e-3, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-8):
+        self.lr, self.beta_1, self.beta_2, self.epsilon = (
+            lr, beta_1, beta_2, epsilon)
+
+    def to_optax(self):
+        return optax.adam(_as_schedule(self.lr), b1=self.beta_1,
+                          b2=self.beta_2, eps=self.epsilon)
+
+
+class AdamWeightDecay(ZooOptimizer):
+    """BERT-style decoupled weight decay excluding LayerNorm/bias params
+    (ref: zoo/.../keras/optimizers/AdamWeightDecay.scala)."""
+
+    EXCLUDE = ("layer_norm", "layernorm", "ln", "bias", "scale")
+
+    def __init__(self, lr: ScheduleLike = 1e-4, weight_decay: float = 0.01,
+                 beta_1: float = 0.9, beta_2: float = 0.999,
+                 epsilon: float = 1e-6,
+                 exclude_from_weight_decay: Optional[Sequence[str]] = None):
+        self.lr, self.weight_decay = lr, weight_decay
+        self.beta_1, self.beta_2, self.epsilon = beta_1, beta_2, epsilon
+        self.exclude = tuple(exclude_from_weight_decay
+                             if exclude_from_weight_decay is not None
+                             else self.EXCLUDE)
+
+    def to_optax(self):
+        import jax
+
+        def mask(params):
+            def keep(path, _):
+                names = [str(getattr(k, "key", getattr(k, "name", k))).lower()
+                         for k in path]
+                return not any(e in n for n in names for e in self.exclude)
+
+            return jax.tree_util.tree_map_with_path(keep, params)
+
+        return optax.adamw(_as_schedule(self.lr), b1=self.beta_1,
+                           b2=self.beta_2, eps=self.epsilon,
+                           weight_decay=self.weight_decay, mask=mask)
+
+
+class RMSprop(ZooOptimizer):
+    def __init__(self, lr: ScheduleLike = 1e-3, decay_rate: float = 0.9,
+                 epsilon: float = 1e-8):
+        self.lr, self.decay_rate, self.epsilon = lr, decay_rate, epsilon
+
+    def to_optax(self):
+        return optax.rmsprop(_as_schedule(self.lr), decay=self.decay_rate,
+                             eps=self.epsilon)
+
+
+class Adagrad(ZooOptimizer):
+    def __init__(self, lr: ScheduleLike = 1e-2):
+        self.lr = lr
+
+    def to_optax(self):
+        return optax.adagrad(_as_schedule(self.lr))
+
+
+class Adadelta(ZooOptimizer):
+    def __init__(self, lr: ScheduleLike = 1.0, rho: float = 0.9,
+                 epsilon: float = 1e-6):
+        self.lr, self.rho, self.epsilon = lr, rho, epsilon
+
+    def to_optax(self):
+        return optax.adadelta(_as_schedule(self.lr), rho=self.rho,
+                              eps=self.epsilon)
+
+
+def resolve_optimizer(opt) -> optax.GradientTransformation:
+    """Accept a ZooOptimizer, an optax transformation, or a name."""
+    if isinstance(opt, ZooOptimizer):
+        return opt.to_optax()
+    if isinstance(opt, optax.GradientTransformation):
+        return opt
+    if isinstance(opt, str):
+        table = {"sgd": SGD, "adam": Adam, "adamw": AdamWeightDecay,
+                 "adamweightdecay": AdamWeightDecay, "rmsprop": RMSprop,
+                 "adagrad": Adagrad, "adadelta": Adadelta}
+        key = opt.lower()
+        if key not in table:
+            raise ValueError(f"unknown optimizer {opt!r}")
+        return table[key]().to_optax()
+    raise TypeError(f"cannot interpret optimizer {opt!r}")
