@@ -49,12 +49,13 @@ def _leading_dim(tree) -> int:
     return n
 
 
-def _take_chunked(tree, idx, memory_type: str, chunk: int = 65536):
+def _take_chunked(tree, idx, memory_type: str, cache_dir: str,
+                  chunk: int = 65536):
     """Index-select rows from a pytree; DISK tier streams through a new
     memmap in chunks so selection never materializes fully in RAM."""
     if memory_type != "DISK":
         return _tree_map(lambda a: np.asarray(a)[idx], tree)
-    cache_dir = tempfile.mkdtemp(prefix="zoo_split_")
+    os.makedirs(cache_dir, exist_ok=True)
     counter = [0]
 
     def take(a):
@@ -106,14 +107,26 @@ class ZooDataset:
         if labels is not None and _leading_dim(labels) != self._n:
             raise ValueError("features and labels disagree on sample count")
         if memory_type == "DISK":
+            owned = cache_dir is None
             cache_dir = cache_dir or tempfile.mkdtemp(prefix="zoo_dataset_")
             features = _spill_to_disk(features, os.path.join(cache_dir, "x"))
             if labels is not None:
                 labels = _spill_to_disk(labels, os.path.join(cache_dir, "y"))
             logger.info("dataset spilled to disk tier at %s", cache_dir)
+            if owned:
+                self._own_cache_dir(cache_dir)
         self.features = features
         self.labels = labels
         self.memory_type = memory_type
+
+    def _own_cache_dir(self, cache_dir: str) -> None:
+        """Delete a framework-created spill dir when the dataset is GC'd
+        (user-supplied cache_dirs are never touched)."""
+        import shutil
+        import weakref
+
+        weakref.finalize(self, shutil.rmtree, cache_dir,
+                         ignore_errors=True)
 
     # ----------------------------------------------------- constructors --
     @staticmethod
@@ -172,14 +185,20 @@ class ZooDataset:
         first, second = perm[:cut], perm[cut:]
 
         def make(idx):
-            feats = _take_chunked(self.features, idx, self.memory_type)
-            labs = (_take_chunked(self.labels, idx, self.memory_type)
+            cache_dir = (tempfile.mkdtemp(prefix="zoo_split_")
+                         if self.memory_type == "DISK" else "")
+            feats = _take_chunked(self.features, idx, self.memory_type,
+                                  cache_dir)
+            labs = (_take_chunked(self.labels, idx, self.memory_type,
+                                  cache_dir)
                     if self.labels is not None else None)
             # _take_chunked already produced disk-backed memmaps for the
             # DISK tier; construct as DRAM to avoid a second spill copy,
             # then restore the tier label.
             child = ZooDataset(feats, labs)
             child.memory_type = self.memory_type
+            if cache_dir:
+                child._own_cache_dir(cache_dir)
             return child
 
         return make(first), make(second)
@@ -245,7 +264,12 @@ class ZooDataset:
             if n_valid < batch_size:  # pad final short batch (tiled wrap)
                 pad = np.resize(order, batch_size - n_valid)
                 global_idx = np.concatenate([global_idx, pad])
-            local_positions = np.arange(batch_size)[proc::n_proc][:local_bs]
+            # contiguous per-process block: process p owns global rows
+            # [p*local_bs, (p+1)*local_bs) -- matches the device order of
+            # hybrid meshes (DCN outermost), so the assembled global array
+            # preserves batch order (unlike strided slicing)
+            local_positions = np.arange(proc * local_bs,
+                                        (proc + 1) * local_bs)
             local_idx = global_idx[local_positions]
             x = _tree_map(lambda a: np.asarray(a[local_idx]), self.features)
             y = (_tree_map(lambda a: np.asarray(a[local_idx]), self.labels)

@@ -121,6 +121,7 @@ class Estimator:
                  clip_norm: Optional[float] = None,
                  clip_value: Optional[float] = None,
                  variables: Optional[Dict[str, Any]] = None,
+                 param_spec_fn: Optional[Callable] = None,
                  seed: int = 0):
         self.adapter = (model if hasattr(model, "apply")
                         and hasattr(model, "init")
@@ -131,6 +132,7 @@ class Estimator:
                                       clip_norm, clip_value)
         self.metrics: List[Metric] = [resolve_metric(m) for m in metrics]
         self.mesh = mesh or default_mesh()
+        self.param_spec_fn = param_spec_fn
         self.seed = seed
         self.variables = variables
         self.opt_state = None
@@ -180,12 +182,22 @@ class Estimator:
             self._place_state()
 
     def _place_state(self) -> None:
-        # replicate model + optimizer state over the mesh; the data axis
-        # shards only the batch. (Param/optimizer sharding specs -- fsdp --
-        # plug in here later via shard_pytree spec_fn.)
-        rep = replicated(self.mesh)
-        self.variables = jax.device_put(self.variables, rep)
-        self.opt_state = jax.device_put(self.opt_state, rep)
+        # default: replicate model + optimizer state over the mesh (the
+        # data axis shards only the batch -- the reference's replicated
+        # model-per-executor layout, Topology.scala:1145+). With
+        # param_spec_fn, parameters AND optimizer moments follow the
+        # given PartitionSpecs (tensor parallelism / sharded embeddings).
+        if self.param_spec_fn is None:
+            rep = replicated(self.mesh)
+            self.variables = jax.device_put(self.variables, rep)
+            self.opt_state = jax.device_put(self.opt_state, rep)
+        else:
+            from analytics_zoo_tpu.parallel.sharding import shard_pytree
+
+            self.variables = shard_pytree(self.variables, self.mesh,
+                                          self.param_spec_fn)
+            self.opt_state = shard_pytree(self.opt_state, self.mesh,
+                                          self.param_spec_fn)
 
     # -------------------------------------------------------- train step --
     def _build_train_step(self):
@@ -280,15 +292,31 @@ class Estimator:
         history: List[Dict[str, float]] = []
         state = TriggerState(epoch=self.epoch, iteration=self.global_step)
         steps_per_epoch = dataset.steps_per_epoch(batch_size)
+        try:
+            return self._fit_loop(
+                dataset, val_dataset, batch_size, epochs, train_step,
+                writer, log_every, retry_times, retry_interval,
+                validation_trigger, checkpoint_trigger, checkpoint_dir,
+                failures, history, state, steps_per_epoch)
+        finally:
+            if writer:
+                writer.close()
+
+    def _fit_loop(self, dataset, val_dataset, batch_size, epochs,
+                  train_step, writer, log_every, retry_times,
+                  retry_interval, validation_trigger, checkpoint_trigger,
+                  checkpoint_dir, failures, history, state,
+                  steps_per_epoch) -> List[Dict[str, float]]:
 
         while self.epoch < epochs:
             epoch_start = time.time()
             losses: List[float] = []
             last_val: Optional[Dict[str, float]] = None
             try:
-                for x, y in dataset.device_iterator(
-                        batch_size, mesh=self.mesh, shuffle=True,
-                        seed=self.seed, epoch=self.epoch):
+                for step_in_epoch, (x, y) in enumerate(
+                        dataset.device_iterator(
+                            batch_size, mesh=self.mesh, shuffle=True,
+                            seed=self.seed, epoch=self.epoch)):
                     self._rng, step_rng = jax.random.split(self._rng)
                     self.variables, self.opt_state, loss = train_step(
                         self.variables, self.opt_state, x, y, step_rng)
@@ -303,13 +331,15 @@ class Estimator:
                             writer.add_scalar("train/loss", lf,
                                               self.global_step)
                     # triggers see every optimization step (the contract of
-                    # triggers.py; makes SeveralIteration/MinLoss live)
+                    # triggers.py; makes SeveralIteration/MinLoss live).
+                    # epoch boundaries count steps *within* this epoch, so
+                    # they stay correct after a mid-epoch restore shifts
+                    # global_step off the modulo grid.
+                    finishing = step_in_epoch == steps_per_epoch - 1
                     state.iteration = self.global_step
                     state.loss = loss
-                    state.epoch = self.epoch + (
-                        1 if self.global_step % steps_per_epoch == 0 else 0)
-                    state.epoch_finished = (
-                        self.global_step % steps_per_epoch == 0)
+                    state.epoch = self.epoch + (1 if finishing else 0)
+                    state.epoch_finished = finishing
                     state.wall_time = time.time()
                     if val_dataset is not None and validation_trigger(state):
                         last_val = self.evaluate(val_dataset, batch_size)
@@ -351,8 +381,6 @@ class Estimator:
                 if not can_retry:
                     raise
                 self._restore(checkpoint_dir)
-        if writer:
-            writer.close()
         return history
 
     def _restore(self, checkpoint_dir: str) -> None:
@@ -400,11 +428,22 @@ class Estimator:
                 lambda variables, x: adapter.apply(variables, x,
                                                    training=False)[0])
         fn = self._predict_fns["predict"]
+
+        def to_host(out):
+            if jax.process_count() > 1:
+                # globally-sharded outputs are not fully addressable per
+                # host; all-gather them (batch order is preserved because
+                # batches() hands each process its contiguous block)
+                from jax.experimental import multihost_utils
+
+                return multihost_utils.process_allgather(out, tiled=True)
+            return jax.device_get(out)
+
         outs: List[Any] = []
         for x, _ in dataset.device_iterator(batch_size, mesh=self.mesh,
                                             shuffle=False,
                                             drop_remainder=False):
-            outs.append(jax.device_get(fn(self.variables, x)))
+            outs.append(to_host(fn(self.variables, x)))
         result = jax.tree_util.tree_map(
             lambda *parts: np.concatenate(parts)[:dataset.num_samples],
             *outs)

@@ -75,7 +75,8 @@ class Accuracy(_MeanMetric):
 
     def _per_sample(self, preds, labels):
         labels = jnp.asarray(labels)
-        if labels.ndim == preds.ndim and labels.shape[-1] > 1:
+        if labels.ndim >= 2 and labels.ndim == preds.ndim and \
+                labels.shape[-1] > 1:
             labels = jnp.argmax(labels, -1)  # one-hot -> sparse
         labels = labels.reshape(labels.shape[0], -1)[:, 0]
         if preds.ndim > 1 and preds.shape[-1] > 1:

@@ -1,0 +1,17 @@
+"""Built-in model zoo.
+
+The analog of the reference's ``models/`` families
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/models -- SURVEY.md
+section 2.1 "built-in models JVM" and 2.2 "models py"): recommendation
+(NeuralCF, WideAndDeep, SessionRecommender), text classification, text
+matching (KNRM), seq2seq, anomaly detection, image classification and
+object detection.
+"""
+
+from analytics_zoo_tpu.models.common import ZooModel  # noqa: F401
+from analytics_zoo_tpu.models.recommendation import (  # noqa: F401
+    NeuralCF,
+    Recommender,
+    UserItemFeature,
+    UserItemPrediction,
+)

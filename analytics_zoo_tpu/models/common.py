@@ -1,0 +1,117 @@
+"""ZooModel: base class for the built-in model zoo.
+
+The analog of ``common/ZooModel`` (ref: zoo/.../models/common/
+ZooModel.scala:38-160 -- save/load/predict base) with the Estimator as the
+training/inference engine. A saved model directory holds ``config.json``
+(model class + constructor kwargs) and an Estimator checkpoint, so
+``ZooModel.load(path)`` reconstructs the exact model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Sequence, Type
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.learn.estimator import Estimator
+
+logger = get_logger(__name__)
+
+_MODEL_REGISTRY: Dict[str, Type["ZooModel"]] = {}
+
+
+class ZooModel:
+    """Base: subclasses define ``_build_module() -> flax module`` plus the
+    loss/optimizer/metrics defaults, and register with @register_model."""
+
+    # subclasses override
+    default_loss: Any = None
+    default_optimizer: Any = "adam"
+    default_metrics: Sequence[Any] = ()
+
+    def __init__(self, **kwargs):
+        self._config = dict(kwargs)
+        self.module = self._build_module()
+        self.estimator = Estimator(
+            self.module, loss=self.default_loss,
+            optimizer=self.default_optimizer,
+            metrics=self.default_metrics)
+
+    def _build_module(self):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ engine --
+    def compile(self, loss=None, optimizer=None, metrics=None, **kwargs):
+        """Re-configure the training engine (Keras-style)."""
+        self.estimator = Estimator(
+            self.module,
+            loss=loss if loss is not None else self.default_loss,
+            optimizer=(optimizer if optimizer is not None
+                       else self.default_optimizer),
+            metrics=metrics if metrics is not None else self.default_metrics,
+            **kwargs)
+        return self
+
+    def fit(self, data, batch_size: int = 256, epochs: int = 1, **kwargs):
+        return self.estimator.fit(data, batch_size=batch_size,
+                                  epochs=epochs, **kwargs)
+
+    def evaluate(self, data, batch_size: int = 256):
+        return self.estimator.evaluate(data, batch_size=batch_size)
+
+    def predict(self, data, batch_size: int = 256):
+        return self.estimator.predict(data, batch_size=batch_size)
+
+    # ------------------------------------------------------- persistence --
+    def save_model(self, path: str) -> None:
+        """(ref: ZooModel.scala saveModel)."""
+        os.makedirs(path, exist_ok=True)
+        if jax.process_index() == 0:
+            with open(os.path.join(path, "config.json"), "w") as f:
+                json.dump({"class": type(self).__name__,
+                           "config": self._config}, f)
+        self.estimator.save(os.path.join(path, "weights"))
+
+    @staticmethod
+    def load_model(path: str) -> "ZooModel":
+        """(ref: ZooModel.scala loadModel)."""
+        with open(os.path.join(path, "config.json")) as f:
+            meta = json.load(f)
+        cls = _MODEL_REGISTRY.get(meta["class"])
+        if cls is None:
+            raise ValueError(f"unknown model class {meta['class']!r}; "
+                             f"known: {sorted(_MODEL_REGISTRY)}")
+        model = cls(**meta["config"])
+        model._build_for_load()
+        model.estimator.load(os.path.join(path, "weights"))
+        return model
+
+    def _build_for_load(self) -> None:
+        """Initialize variables with a dummy batch so load() has a
+        template. Subclasses provide ``_example_input()``."""
+        x = self._example_input()
+        self.estimator._ensure_built(x)
+
+    def _example_input(self):
+        raise NotImplementedError
+
+    def summary(self) -> str:
+        lines = [f"{type(self).__name__}("]
+        for k, v in self._config.items():
+            lines.append(f"  {k}={v},")
+        lines.append(")")
+        if self.estimator.variables is not None:
+            n = sum(int(np.prod(l.shape)) for l in
+                    jax.tree_util.tree_leaves(
+                        self.estimator.variables.get("params", {})))
+            lines.append(f"total params: {n:,}")
+        return "\n".join(lines)
+
+
+def register_model(cls: Type[ZooModel]) -> Type[ZooModel]:
+    _MODEL_REGISTRY[cls.__name__] = cls
+    return cls

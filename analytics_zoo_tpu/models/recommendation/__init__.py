@@ -1,0 +1,8 @@
+"""Recommendation models (ref: zoo/.../models/recommendation)."""
+
+from analytics_zoo_tpu.models.recommendation.base import (  # noqa: F401
+    Recommender,
+    UserItemFeature,
+    UserItemPrediction,
+)
+from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF  # noqa: F401

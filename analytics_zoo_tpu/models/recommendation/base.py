@@ -1,0 +1,99 @@
+"""Recommender base: user/item pair scoring and top-K recommendation.
+
+The analog of ``Recommender`` (ref: zoo/.../models/recommendation/
+Recommender.scala -- predictUserItemPair, recommendForUser,
+recommendForItem) with the Spark RDD surface replaced by numpy batches
+scored through one jitted forward.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel
+
+
+@dataclass
+class UserItemFeature:
+    """(ref: recommendation/UserItemFeature.scala)."""
+
+    user_id: int
+    item_id: int
+    label: int = 0
+
+
+@dataclass
+class UserItemPrediction:
+    """(ref: recommendation/UserItemPrediction.scala)."""
+
+    user_id: int
+    item_id: int
+    prediction: int
+    probability: float
+
+
+class Recommender(ZooModel):
+    """Subclasses score (user, item) int pairs via predict()."""
+
+    def _pair_matrix(self, users, items) -> np.ndarray:
+        return np.stack([np.asarray(users, np.int32),
+                         np.asarray(items, np.int32)], axis=1)
+
+    def predict_user_item_pair(
+            self, pairs: Sequence[UserItemFeature],
+            batch_size: int = 1024) -> List[UserItemPrediction]:
+        """(ref: Recommender.scala predictUserItemPair)."""
+        users = [p.user_id for p in pairs]
+        items = [p.item_id for p in pairs]
+        probs = self.predict(self._pair_matrix(users, items),
+                             batch_size=batch_size)
+        return [self._to_prediction(u, i, p)
+                for u, i, p in zip(users, items, probs)]
+
+    def recommend_for_user(self, user_id: int, max_items: int,
+                           candidate_items: Sequence[int] = None,
+                           batch_size: int = 1024
+                           ) -> List[UserItemPrediction]:
+        """Top-K items for one user (ref: Recommender.scala
+        recommendForUser)."""
+        items = np.asarray(candidate_items if candidate_items is not None
+                           else np.arange(1, self.item_count + 1), np.int32)
+        users = np.full_like(items, user_id)
+        probs = self.predict(self._pair_matrix(users, items),
+                             batch_size=batch_size)
+        preds = [self._to_prediction(int(u), int(i), p)
+                 for u, i, p in zip(users, items, probs)]
+        preds.sort(key=lambda r: -r.probability)
+        return preds[:max_items]
+
+    def recommend_for_item(self, item_id: int, max_users: int,
+                           candidate_users: Sequence[int] = None,
+                           batch_size: int = 1024
+                           ) -> List[UserItemPrediction]:
+        """(ref: Recommender.scala recommendForItem)."""
+        users = np.asarray(candidate_users if candidate_users is not None
+                           else np.arange(1, self.user_count + 1), np.int32)
+        items = np.full_like(users, item_id)
+        probs = self.predict(self._pair_matrix(users, items),
+                             batch_size=batch_size)
+        preds = [self._to_prediction(int(u), int(i), p)
+                 for u, i, p in zip(users, items, probs)]
+        preds.sort(key=lambda r: -r.probability)
+        return preds[:max_users]
+
+    def _to_prediction(self, user, item, probs) -> UserItemPrediction:
+        probs = np.asarray(probs).reshape(-1)
+        if probs.shape[0] > 1:  # class logits -> softmax
+            e = np.exp(probs - probs.max())
+            sm = e / e.sum()
+            cls = int(np.argmax(sm))
+            # class index c encodes label c+1 (ratings are 1-based,
+            # ref: NeuralCFSpec label handling)
+            return UserItemPrediction(int(user), int(item), cls + 1,
+                                      float(sm[cls]))
+        score = float(1.0 / (1.0 + np.exp(-probs[0])))
+        return UserItemPrediction(int(user), int(item),
+                                  int(score > 0.5), score)

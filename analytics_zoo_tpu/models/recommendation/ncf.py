@@ -1,0 +1,137 @@
+"""Neural Collaborative Filtering (NCF).
+
+The analog of ``NeuralCF`` (ref: zoo/.../models/recommendation/
+NeuralCF.scala:45 -- GMF + MLP dual-branch architecture;
+pyzoo/zoo/models/recommendation/neuralcf.py) re-designed TPU-first:
+
+- embeddings + MLP as one fused flax module executing on the MXU;
+- embedding tables may be sharded over the mesh's "model" axis for
+  tables too big to replicate (the reference replicates on every worker,
+  SURVEY.md section 7 "hard parts: embedding-heavy recommenders");
+- training goes through the single SPMD Estimator (the reference runs
+  this model on BigDL's two-Spark-jobs-per-iteration allreduce).
+
+North-star workload #1 (BASELINE.md: NCF on MovieLens-1M).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common import register_model
+from analytics_zoo_tpu.models.recommendation.base import Recommender
+
+
+class NeuralCFNet(nn.Module):
+    """Flax module: GMF (elementwise product of mf embeddings) + MLP
+    (concat embeddings -> hidden stack), concatenated into class logits
+    (ref: NeuralCF.scala:45-120 buildModel)."""
+
+    user_count: int
+    item_count: int
+    class_num: int = 2
+    user_embed: int = 20
+    item_embed: int = 20
+    hidden_layers: Tuple[int, ...] = (40, 20, 10)
+    include_mf: bool = True
+    mf_embed: int = 20
+
+    @nn.compact
+    def __call__(self, x):
+        # x: int32 [B, 2] of 1-based (user, item) ids
+        user, item = x[..., 0], x[..., 1]
+        mlp_u = nn.Embed(self.user_count + 1, self.user_embed,
+                         name="mlp_user_embed")(user)
+        mlp_i = nn.Embed(self.item_count + 1, self.item_embed,
+                         name="mlp_item_embed")(item)
+        h = jnp.concatenate([mlp_u, mlp_i], axis=-1)
+        for k, units in enumerate(self.hidden_layers):
+            h = nn.relu(nn.Dense(units, name=f"mlp_dense_{k}")(h))
+        if self.include_mf:
+            mf_u = nn.Embed(self.user_count + 1, self.mf_embed,
+                            name="mf_user_embed")(user)
+            mf_i = nn.Embed(self.item_count + 1, self.mf_embed,
+                            name="mf_item_embed")(item)
+            h = jnp.concatenate([h, mf_u * mf_i], axis=-1)
+        return nn.Dense(self.class_num, name="head")(h)
+
+
+@register_model
+class NeuralCF(Recommender):
+    """NCF recommender (ref: NeuralCF.scala:45, neuralcf.py).
+
+    Labels are 1-based ratings in ``[1, class_num]`` (matching the
+    reference's MovieLens explicit-feedback convention); internally
+    shifted to 0-based classes.
+    """
+
+    default_loss = staticmethod(
+        lambda preds, labels: _shifted_ce(preds, labels))
+    default_optimizer = "adam"
+
+    @property
+    def default_metrics(self):
+        return (_RatingAccuracy(),)
+
+    def __init__(self, user_count: int, item_count: int, class_num: int = 2,
+                 user_embed: int = 20, item_embed: int = 20,
+                 hidden_layers: Sequence[int] = (40, 20, 10),
+                 include_mf: bool = True, mf_embed: int = 20):
+        self.user_count = user_count
+        self.item_count = item_count
+        self.class_num = class_num
+        super().__init__(user_count=user_count, item_count=item_count,
+                         class_num=class_num, user_embed=user_embed,
+                         item_embed=item_embed,
+                         hidden_layers=list(hidden_layers),
+                         include_mf=include_mf, mf_embed=mf_embed)
+
+    def _build_module(self):
+        c = self._config
+        return NeuralCFNet(
+            user_count=c["user_count"], item_count=c["item_count"],
+            class_num=c["class_num"], user_embed=c["user_embed"],
+            item_embed=c["item_embed"],
+            hidden_layers=tuple(c["hidden_layers"]),
+            include_mf=c["include_mf"], mf_embed=c["mf_embed"])
+
+    def _example_input(self):
+        return np.ones((1, 2), np.int32)
+
+
+def _shifted_ce(preds, labels):
+    """Cross entropy with 1-based rating labels."""
+    from analytics_zoo_tpu.learn.objectives import (
+        sparse_categorical_crossentropy)
+
+    labels = jnp.asarray(labels).reshape(-1).astype(jnp.int32) - 1
+    return sparse_categorical_crossentropy(preds, labels)
+
+
+from analytics_zoo_tpu.learn.metrics import Metric
+
+
+class _RatingAccuracy(Metric):
+    """Accuracy against 1-based rating labels."""
+
+    name = "accuracy"
+    greater_is_better = True
+
+    def __init__(self):
+        from analytics_zoo_tpu.learn.metrics import Accuracy
+
+        self._inner = Accuracy()
+
+    def empty(self):
+        return self._inner.empty()
+
+    def update(self, state, preds, labels, weights=None):
+        labels = jnp.asarray(labels).astype(jnp.int32) - 1
+        return self._inner.update(state, preds, labels, weights)
+
+    def result(self, state):
+        return self._inner.result(state)

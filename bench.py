@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Benchmark: NCF training throughput (north-star workload #1).
+
+Measures samples/sec/chip for NeuralCF on MovieLens-1M-scale synthetic
+data through the full Estimator SPMD train path (ref workload:
+apps/recommendation-ncf/ncf-explicit-feedback.ipynb via NNEstimator,
+BASELINE.md config #1).
+
+``vs_baseline`` is the speedup over the identical train step on the host
+CPU (measured in a subprocess, cached in .bench_cpu_baseline.json): the
+reference is a CPU/MKL framework, so TPU-vs-host-CPU through the same
+code path is the meaningful ratio while the reference publishes no
+absolute numbers (BASELINE.md).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+# MovieLens-1M scale (ref: ml-1m 6040 users / 3706 movies, 5-star ratings)
+USERS, ITEMS, CLASSES = 6040, 3706, 5
+BATCH = 8192
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+CPU_BASELINE_FILE = os.path.join(REPO, ".bench_cpu_baseline.json")
+
+
+def measure(steps: int, warmup: int, batch: int) -> float:
+    """Samples/sec of the NCF train step on the current jax platform."""
+    import jax
+    import numpy as np
+
+    from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF
+
+    rng = np.random.RandomState(0)
+    n = batch * 4
+    x = np.stack([rng.randint(1, USERS + 1, n),
+                  rng.randint(1, ITEMS + 1, n)], axis=1).astype(np.int32)
+    y = rng.randint(1, CLASSES + 1, n).astype(np.int32)
+
+    model = NeuralCF(USERS, ITEMS, class_num=CLASSES)
+    est = model.estimator
+    est._ensure_built(x[:1])
+    step_fn = est._build_train_step()
+
+    from analytics_zoo_tpu.parallel.sharding import shard_batch
+
+    xb = shard_batch(x[:batch], est.mesh)
+    yb = shard_batch(y[:batch], est.mesh)
+    key = jax.random.PRNGKey(0)
+
+    variables, opt_state = est.variables, est.opt_state
+    for _ in range(warmup):
+        variables, opt_state, loss = step_fn(variables, opt_state, xb, yb,
+                                             key)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        variables, opt_state, loss = step_fn(variables, opt_state, xb, yb,
+                                             key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def cpu_baseline() -> float:
+    """Measure (or load cached) host-CPU samples/sec for vs_baseline."""
+    if os.path.isfile(CPU_BASELINE_FILE):
+        with open(CPU_BASELINE_FILE) as f:
+            return json.load(f)["samples_per_sec"]
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import bench\n"
+        "v = bench.measure(steps=5, warmup=2, batch=bench.BATCH)\n"
+        "print('CPU_RESULT', v)\n" % REPO)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=1200, cwd=REPO)
+    for line in out.stdout.splitlines():
+        if line.startswith("CPU_RESULT"):
+            v = float(line.split()[1])
+            with open(CPU_BASELINE_FILE, "w") as f:
+                json.dump({"samples_per_sec": v, "batch": BATCH}, f)
+            return v
+    raise RuntimeError(f"cpu baseline failed: {out.stderr[-2000:]}")
+
+
+def main():
+    import jax
+
+    n_chips = len(jax.devices())
+    total = measure(MEASURE_STEPS, WARMUP_STEPS, BATCH)
+    per_chip = total / n_chips
+    try:
+        base = cpu_baseline()
+        vs = total / base
+    except Exception as e:  # never let baseline kill the bench line
+        print(f"warning: cpu baseline unavailable: {e}", file=sys.stderr)
+        vs = 1.0
+    print(json.dumps({
+        "metric": "ncf_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
