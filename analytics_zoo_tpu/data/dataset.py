@@ -187,10 +187,13 @@ class ZooDataset:
         def make(idx):
             cache_dir = (tempfile.mkdtemp(prefix="zoo_split_")
                          if self.memory_type == "DISK" else "")
+            # distinct subdirs: _take_chunked restarts its arr_<n> counter
+            # per call, so sharing one dir would overwrite features with
+            # labels
             feats = _take_chunked(self.features, idx, self.memory_type,
-                                  cache_dir)
+                                  os.path.join(cache_dir, "x"))
             labs = (_take_chunked(self.labels, idx, self.memory_type,
-                                  cache_dir)
+                                  os.path.join(cache_dir, "y"))
                     if self.labels is not None else None)
             # _take_chunked already produced disk-backed memmaps for the
             # DISK tier; construct as DRAM to avoid a second spill copy,

@@ -1,0 +1,25 @@
+"""Keras-style model API.
+
+The analog of the reference's Keras layer library + topology
+(ref: zoo/.../pipeline/api/keras -- 120 layer files, Topology.scala
+KerasNet/Sequential/Model; pyzoo/zoo/pipeline/api/keras). Layers are
+declarative configs that build flax modules; ``Sequential`` and graph
+``Model`` compile into the SPMD Estimator (where the reference compiles
+into BigDL's DistriOptimizer).
+
+TPU-first deviations from the reference (deliberate):
+- channels-last (NHWC) conv layout -- the TPU-native layout -- instead of
+  BigDL's NCHW;
+- weights are flax pytrees, not BigDL tensors; import/export helpers live
+  in ``analytics_zoo_tpu.inference``.
+"""
+
+from analytics_zoo_tpu.keras.engine import (  # noqa: F401
+    Input,
+    KTensor,
+    Model,
+    Sequential,
+)
+from analytics_zoo_tpu.keras import layers  # noqa: F401
+from analytics_zoo_tpu.keras import activations  # noqa: F401
+from analytics_zoo_tpu.learn import objectives  # noqa: F401

@@ -1,0 +1,47 @@
+"""Activation registry (ref: zoo/.../keras/layers activations via
+KerasUtils.getActivation; keras1 activation set)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def linear(x):
+    return x
+
+
+def hard_sigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+_ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "hard_sigmoid": hard_sigmoid,
+    "linear": linear,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.swish,
+    "silu": jax.nn.silu,
+    "log_softmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+}
+
+
+def get(name: Optional[Union[str, Callable]]) -> Callable:
+    if name is None:
+        return linear
+    if callable(name):
+        return name
+    key = name.lower()
+    if key not in _ACTIVATIONS:
+        raise ValueError(
+            f"unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[key]

@@ -1,0 +1,325 @@
+"""Keras engine: symbolic tensors, Sequential and graph Model topologies.
+
+The analog of ``KerasNet``/``Sequential``/``Model``
+(ref: zoo/.../keras/models/Topology.scala:67-988,
+pyzoo/zoo/pipeline/api/keras/engine/topology.py:31). Where the reference
+compiles a topology into BigDL's DistriOptimizer, here ``compile()``
+configures the SPMD Estimator and ``fit`` runs the jitted sharded step.
+
+Graph building mirrors the Keras functional API: ``Input`` creates a
+symbolic :class:`KTensor`; calling a layer on KTensors records a
+:class:`Node`; ``Model(input, output)`` topologically sorts the DAG into
+one flax module. KTensor arithmetic (+, -, *, /) provides the autograd
+``Variable`` sugar (ref: zoo/.../pipeline/api/autograd -- math on graph
+nodes).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+_uid = itertools.count()
+
+
+class Node:
+    """One layer invocation in the graph."""
+
+    def __init__(self, layer, inputs: List["KTensor"]):
+        self.layer = layer
+        self.inputs = inputs
+        self.id = next(_uid)
+
+
+class KTensor:
+    """Symbolic tensor: the output of a Node (or a graph input)."""
+
+    def __init__(self, node: Optional[Node], shape: Optional[Tuple] = None,
+                 input_index: Optional[int] = None):
+        self.node = node
+        self.shape = shape  # without batch dim, may be None
+        self.input_index = input_index  # set for graph inputs
+
+    # autograd-style arithmetic sugar (ref: api/autograd math.scala)
+    def __add__(self, other):
+        return _lambda_op("add", self, other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _lambda_op("sub", self, other)
+
+    def __rsub__(self, other):
+        return _lambda_op("rsub", self, other)
+
+    def __mul__(self, other):
+        return _lambda_op("mul", self, other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _lambda_op("div", self, other)
+
+    def __rtruediv__(self, other):
+        return _lambda_op("rdiv", self, other)
+
+    def __neg__(self):
+        return _lambda_op("neg", self)
+
+
+def Input(shape: Tuple, name: Optional[str] = None) -> KTensor:
+    """Graph input placeholder; ``shape`` excludes the batch dim
+    (ref: keras/engine Input / InputLayer)."""
+    return KTensor(node=None, shape=tuple(shape),
+                   input_index=next(_uid))
+
+
+def _lambda_op(op: str, a, b=None) -> KTensor:
+    from analytics_zoo_tpu.keras.layers.core import Lambda
+
+    ops: Dict[str, Callable] = {
+        "add": lambda x, y: x + y, "sub": lambda x, y: x - y,
+        "rsub": lambda x, y: y - x, "mul": lambda x, y: x * y,
+        "div": lambda x, y: x / y, "rdiv": lambda x, y: y / x,
+        "neg": lambda x: -x,
+    }
+    fn = ops[op]
+    if b is None:
+        return Lambda(fn, name=f"lambda_{op}_{next(_uid)}")(a)
+    if isinstance(b, KTensor):
+        lam = Lambda(lambda xs: fn(xs[0], xs[1]),
+                     name=f"lambda_{op}_{next(_uid)}")
+        return lam([a, b])
+    const = b
+    return Lambda(lambda x: fn(x, const),
+                  name=f"lambda_{op}_{next(_uid)}")(a)
+
+
+# ------------------------------------------------------------- modules ---
+
+
+class _SequentialModule(nn.Module):
+    """Applies built layer modules in order with a uniform train flag."""
+
+    modules: Tuple[nn.Module, ...]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for m in self.modules:
+            x = m(x, train=train)
+        return x
+
+
+class _GraphModule(nn.Module):
+    """Executes a topologically-sorted DAG of layer modules.
+
+    ``steps`` is a tuple of (module, input_slot_ids, output_slot_id);
+    slot ids reference graph inputs (negative: -1-index) or prior node
+    outputs.
+    """
+
+    modules: Tuple[nn.Module, ...]
+    input_slots: Tuple[Tuple[int, ...], ...]
+    n_inputs: int
+
+    @nn.compact
+    def __call__(self, *xs, train: bool = False):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        inputs = list(xs)
+        if len(inputs) != self.n_inputs:
+            raise ValueError(
+                f"model expects {self.n_inputs} inputs, got {len(inputs)}")
+        values: List[Any] = list(inputs)
+        for m, slots in zip(self.modules, self.input_slots):
+            args = [values[s] for s in slots]
+            out = m(args if len(args) > 1 else args[0], train=train)
+            values.append(out)
+        return values[-1]
+
+
+# ------------------------------------------------------------ topology ---
+
+
+class KerasNet:
+    """compile/fit/evaluate/predict surface shared by Sequential and Model
+    (ref: Topology.scala:67-630 KerasNet)."""
+
+    def __init__(self):
+        self._module: Optional[nn.Module] = None
+        self.estimator = None
+        self._loss = None
+        self._optimizer = "adam"
+        self._metrics: Sequence[Any] = ()
+        self._checkpoint_dir = None
+        self._checkpoint_trigger = None
+        self._log_dir = None
+
+    def _build_module(self) -> nn.Module:
+        raise NotImplementedError
+
+    @property
+    def module(self) -> nn.Module:
+        if self._module is None:
+            self._module = self._build_module()
+        return self._module
+
+    def compile(self, optimizer="adam", loss=None, metrics=()):
+        """(ref: Topology.scala compile). Recompiling preserves trained
+        weights (Keras contract)."""
+        self._optimizer, self._loss, self._metrics = optimizer, loss, metrics
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        old = self.estimator
+        self.estimator = Estimator(
+            self.module, loss=loss, optimizer=optimizer, metrics=metrics,
+            variables=old.variables if old is not None else None)
+        if old is not None:
+            self.estimator.global_step = old.global_step
+            self.estimator.epoch = old.epoch
+        return self
+
+    def set_checkpoint(self, path: str, over_write: bool = True,
+                       trigger=None):
+        """(ref: Topology.scala:249 setCheckpoint)."""
+        self._checkpoint_dir = path
+        self._checkpoint_trigger = trigger
+        return self
+
+    def set_tensorboard(self, log_dir: str, app_name: str = "zoo"):
+        """(ref: Topology.scala:208 setTensorBoard)."""
+        import os
+
+        self._log_dir = os.path.join(log_dir, app_name)
+        return self
+
+    def _require_compiled(self):
+        if self.estimator is None:
+            raise RuntimeError("call compile(optimizer, loss) before "
+                               "fit/evaluate")
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, **kwargs):
+        """(ref: Topology.scala fit; keras fit signature)."""
+        self._require_compiled()
+        data = (x, y) if y is not None else x
+        return self.estimator.fit(
+            data, batch_size=batch_size, epochs=nb_epoch,
+            validation_data=validation_data,
+            checkpoint_dir=self._checkpoint_dir,
+            checkpoint_trigger=self._checkpoint_trigger,
+            log_dir=self._log_dir, **kwargs)
+
+    def evaluate(self, x, y=None, batch_size: int = 32):
+        self._require_compiled()
+        data = (x, y) if y is not None else x
+        return self.estimator.evaluate(data, batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 32):
+        if self.estimator is None:
+            from analytics_zoo_tpu.learn.estimator import Estimator
+
+            self.estimator = Estimator(self.module)
+        return self.estimator.predict(x, batch_size=batch_size)
+
+    def save_weights(self, path: str):
+        self._require_compiled()
+        self.estimator.save(path)
+
+    def load_weights(self, path: str):
+        self._require_compiled()
+        self.estimator.load(path)
+
+    def get_train_summary(self, tag: str = "train/loss"):
+        """Read back TB scalars (ref: Topology.scala:1390
+        getTrainSummary)."""
+        from analytics_zoo_tpu.utils.summary import read_events
+
+        if self._log_dir is None:
+            raise RuntimeError("set_tensorboard was not called")
+        return read_events(self._log_dir).get(tag, [])
+
+
+class Sequential(KerasNet):
+    """(ref: Topology.scala:631+ Sequential, keras Sequential)."""
+
+    def __init__(self, layers: Optional[Sequence] = None):
+        super().__init__()
+        self.layers: List = []
+        for l in layers or []:
+            self.add(l)
+
+    def add(self, layer) -> "Sequential":
+        if self._module is not None:
+            raise RuntimeError("cannot add layers after the model is built")
+        self.layers.append(layer)
+        return self
+
+    def _build_module(self) -> nn.Module:
+        if not self.layers:
+            raise ValueError("empty Sequential")
+        return _SequentialModule(
+            modules=tuple(l.build() for l in self.layers))
+
+    def summary(self) -> str:
+        lines = ["Sequential {"]
+        for l in self.layers:
+            lines.append(f"  {l!r}")
+        lines.append("}")
+        return "\n".join(lines)
+
+
+class Model(KerasNet):
+    """Functional graph model (ref: Topology.scala Model; keras Model)."""
+
+    def __init__(self, input: Union[KTensor, Sequence[KTensor]],
+                 output: KTensor):
+        super().__init__()
+        self.inputs: List[KTensor] = (list(input)
+                                      if isinstance(input, (list, tuple))
+                                      else [input])
+        self.output = output
+        for i, t in enumerate(self.inputs):
+            if t.input_index is None:
+                raise ValueError(f"input {i} is not an Input() tensor")
+
+    def _build_module(self) -> nn.Module:
+        # topo-sort nodes reachable from output
+        order: List[Node] = []
+        seen: Dict[int, int] = {}  # node id -> slot
+        input_slot = {t.input_index: i for i, t in enumerate(self.inputs)}
+
+        def slot_of(t: KTensor) -> int:
+            if t.node is None:
+                if t.input_index not in input_slot:
+                    raise ValueError("graph references an Input that is "
+                                     "not among the model inputs")
+                return input_slot[t.input_index]
+            if t.node.id not in seen:
+                visit(t.node)
+            return seen[t.node.id]
+
+        def visit(node: Node):
+            slots = tuple(slot_of(i) for i in node.inputs)
+            node._slots = slots
+            seen[node.id] = len(self.inputs) + len(order)
+            order.append(node)
+
+        out_slot = slot_of(self.output)
+        assert out_slot == len(self.inputs) + len(order) - 1, \
+            "output must be the last computed node"
+        return _GraphModule(
+            modules=tuple(n.layer.build() for n in order),
+            input_slots=tuple(n._slots for n in order),
+            n_inputs=len(self.inputs))
+
+    def summary(self) -> str:
+        return f"Model(inputs={len(self.inputs)})"

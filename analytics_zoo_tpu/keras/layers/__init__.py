@@ -1,0 +1,80 @@
+"""Keras layer library (ref: zoo/.../pipeline/api/keras/layers -- 120
+layer files; re-exported here by family)."""
+
+from analytics_zoo_tpu.keras.layers.core import (  # noqa: F401
+    Activation,
+    Dense,
+    Dropout,
+    Flatten,
+    GaussianNoise,
+    Highway,
+    InputLayer,
+    Lambda,
+    Permute,
+    RepeatVector,
+    Reshape,
+    SReLU,
+)
+from analytics_zoo_tpu.keras.layers.convolutional import (  # noqa: F401
+    AtrousConvolution1D,
+    AtrousConvolution2D,
+    Convolution1D,
+    Convolution2D,
+    Convolution3D,
+    Cropping1D,
+    Cropping2D,
+    Cropping3D,
+    Deconvolution2D,
+    SeparableConvolution2D,
+    UpSampling1D,
+    UpSampling2D,
+    UpSampling3D,
+    ZeroPadding1D,
+    ZeroPadding2D,
+    ZeroPadding3D,
+)
+from analytics_zoo_tpu.keras.layers.pooling import (  # noqa: F401
+    AveragePooling1D,
+    AveragePooling2D,
+    AveragePooling3D,
+    GlobalAveragePooling1D,
+    GlobalAveragePooling2D,
+    GlobalAveragePooling3D,
+    GlobalMaxPooling1D,
+    GlobalMaxPooling2D,
+    GlobalMaxPooling3D,
+    MaxPooling1D,
+    MaxPooling2D,
+    MaxPooling3D,
+)
+from analytics_zoo_tpu.keras.layers.normalization import (  # noqa: F401
+    BatchNormalization,
+    LayerNormalization,
+)
+from analytics_zoo_tpu.keras.layers.embedding import (  # noqa: F401
+    Embedding,
+    WordEmbedding,
+)
+from analytics_zoo_tpu.keras.layers.recurrent import (  # noqa: F401
+    GRU,
+    LSTM,
+    Bidirectional,
+    ConvLSTM2D,
+    SimpleRNN,
+    TimeDistributed,
+)
+from analytics_zoo_tpu.keras.layers.merge import (  # noqa: F401
+    Merge,
+    average,
+    concatenate,
+    dot,
+    maximum,
+    multiply,
+)
+from analytics_zoo_tpu.keras.layers.merge import add as merge_add  # noqa: F401
+from analytics_zoo_tpu.keras.layers.advanced_activations import (  # noqa: F401
+    ELU,
+    LeakyReLU,
+    PReLU,
+    ThresholdedReLU,
+)

@@ -1,0 +1,53 @@
+"""Advanced activation layers (ref: zoo/.../keras/layers/{LeakyReLU,ELU,
+PReLU,ThresholdedReLU}.scala)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.layers.base import FnModule, KerasLayer
+
+
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha: float = 0.3, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = alpha
+
+    def _make_module(self):
+        a = self.alpha
+        return FnModule(fn=lambda x: jnp.where(x >= 0, x, a * x))
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = alpha
+
+    def _make_module(self):
+        a = self.alpha
+        return FnModule(fn=lambda x: jax.nn.elu(x, alpha=a))
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.theta = theta
+
+    def _make_module(self):
+        t = self.theta
+        return FnModule(fn=lambda x: jnp.where(x > t, x, 0.0))
+
+
+class _PReLUModule(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        alpha = self.param("alpha", nn.initializers.constant(0.25),
+                           (x.shape[-1],))
+        return jnp.where(x >= 0, x, alpha * x)
+
+
+class PReLU(KerasLayer):
+    def _make_module(self):
+        return _PReLUModule()

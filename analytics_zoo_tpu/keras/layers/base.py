@@ -1,0 +1,60 @@
+"""KerasLayer base: declarative layer config that builds a flax module.
+
+Every built module has the uniform signature ``__call__(x, train=False)``
+so Sequential / graph execution can thread the training flag blindly
+(the analog of the reference's ``KerasLayer`` adapter that gives BigDL
+modules Keras semantics, ref: zoo/.../keras/layers/KerasLayer via
+``KerasUtils``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Optional
+
+import flax.linen as nn
+
+_uid = itertools.count()
+
+
+class KerasLayer:
+    def __init__(self, name: Optional[str] = None, input_shape=None):
+        self.name = name or f"{type(self).__name__.lower()}_{next(_uid)}"
+        self.input_shape = (tuple(input_shape)
+                            if input_shape is not None else None)
+        self._built = None
+
+    def build(self) -> nn.Module:
+        """Return the (unbound) flax module implementing this layer."""
+        if self._built is None:
+            self._built = self._make_module()
+        return self._built
+
+    def _make_module(self) -> nn.Module:
+        raise NotImplementedError
+
+    def __call__(self, x):
+        """Symbolic call on KTensor(s): records a graph Node."""
+        from analytics_zoo_tpu.keras.engine import KTensor, Node
+
+        inputs = list(x) if isinstance(x, (list, tuple)) else [x]
+        if not all(isinstance(t, KTensor) for t in inputs):
+            raise TypeError(
+                "layers are called on symbolic KTensors (from Input()); "
+                "to run on data, put the layer in a Sequential/Model and "
+                "call predict")
+        node = Node(self, inputs)
+        return KTensor(node)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(name={self.name!r})"
+
+
+class FnModule(nn.Module):
+    """Stateless layer module from a pure function."""
+
+    fn: Callable
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return self.fn(x)

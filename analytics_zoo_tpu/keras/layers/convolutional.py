@@ -1,0 +1,277 @@
+"""Convolution / padding / cropping / upsampling layers.
+
+(ref: zoo/.../keras/layers/{Convolution1D,Convolution2D,Convolution3D,
+Deconvolution2D,SeparableConvolution2D,AtrousConvolution1D/2D,
+Cropping*,UpSampling*,ZeroPadding*}.scala)
+
+TPU-first deviation: channels-LAST layouts ([B,L,C], [B,H,W,C],
+[B,D,H,W,C]) -- the native TPU conv layout -- where BigDL uses NCHW.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras import activations
+from analytics_zoo_tpu.keras.layers.base import FnModule, KerasLayer
+
+
+def _tup(v, n):
+    if isinstance(v, (tuple, list)):
+        if len(v) != n:
+            raise ValueError(f"expected {n} values, got {v}")
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+class _ConvModule(nn.Module):
+    features: int
+    kernel: Tuple[int, ...]
+    strides: Tuple[int, ...]
+    padding: str
+    dilation: Tuple[int, ...]
+    activation: Callable
+    use_bias: bool
+    transpose: bool = False
+    groups: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cls = nn.ConvTranspose if self.transpose else nn.Conv
+        kwargs = {} if self.transpose else {
+            "feature_group_count": self.groups}
+        y = cls(self.features, self.kernel, strides=self.strides,
+                padding=self.padding.upper(),
+                kernel_dilation=self.dilation,
+                use_bias=self.use_bias, **kwargs)(x)
+        return self.activation(y)
+
+
+class _ConvBase(KerasLayer):
+    rank = 2
+
+    def __init__(self, nb_filter: int, kernel, subsample=1,
+                 activation=None, border_mode: str = "valid",
+                 bias: bool = True, dilation_rate=1, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.kernel = _tup(kernel, self.rank)
+        self.subsample = _tup(subsample, self.rank)
+        self.dilation = _tup(dilation_rate, self.rank)
+        self.activation = activations.get(activation)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"border_mode must be valid/same, "
+                             f"got {border_mode!r}")
+        self.border_mode = border_mode
+        self.bias = bias
+
+    def _make_module(self):
+        return _ConvModule(
+            features=self.nb_filter, kernel=self.kernel,
+            strides=self.subsample, padding=self.border_mode,
+            dilation=self.dilation, activation=self.activation,
+            use_bias=self.bias)
+
+
+class Convolution1D(_ConvBase):
+    rank = 1
+
+    def __init__(self, nb_filter, filter_length, subsample_length=1,
+                 **kwargs):
+        super().__init__(nb_filter, filter_length,
+                         subsample=subsample_length, **kwargs)
+
+
+class Convolution2D(_ConvBase):
+    rank = 2
+
+    def __init__(self, nb_filter, nb_row, nb_col=None, subsample=(1, 1),
+                 **kwargs):
+        kernel = (nb_row, nb_col) if nb_col is not None else nb_row
+        super().__init__(nb_filter, kernel, subsample=subsample, **kwargs)
+
+
+class Convolution3D(_ConvBase):
+    rank = 3
+
+    def __init__(self, nb_filter, kernel_dim1, kernel_dim2=None,
+                 kernel_dim3=None, subsample=(1, 1, 1), **kwargs):
+        kernel = ((kernel_dim1, kernel_dim2, kernel_dim3)
+                  if kernel_dim2 is not None else kernel_dim1)
+        super().__init__(nb_filter, kernel, subsample=subsample, **kwargs)
+
+
+class AtrousConvolution1D(Convolution1D):
+    def __init__(self, nb_filter, filter_length, atrous_rate=1, **kwargs):
+        super().__init__(nb_filter, filter_length,
+                         dilation_rate=atrous_rate, **kwargs)
+
+
+class AtrousConvolution2D(Convolution2D):
+    def __init__(self, nb_filter, nb_row, nb_col=None, atrous_rate=(1, 1),
+                 **kwargs):
+        super().__init__(nb_filter, nb_row, nb_col,
+                         dilation_rate=atrous_rate, **kwargs)
+
+
+class Deconvolution2D(_ConvBase):
+    """Transposed conv (ref: keras/layers/Deconvolution2D.scala)."""
+
+    rank = 2
+
+    def __init__(self, nb_filter, nb_row, nb_col=None, subsample=(1, 1),
+                 **kwargs):
+        kernel = (nb_row, nb_col) if nb_col is not None else nb_row
+        super().__init__(nb_filter, kernel, subsample=subsample, **kwargs)
+
+    def _make_module(self):
+        return _ConvModule(
+            features=self.nb_filter, kernel=self.kernel,
+            strides=self.subsample, padding=self.border_mode,
+            dilation=self.dilation, activation=self.activation,
+            use_bias=self.bias, transpose=True)
+
+
+class _SeparableConv2DModule(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int]
+    padding: str
+    depth_multiplier: int
+    activation: Callable
+    use_bias: bool
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        depth = nn.Conv(in_ch * self.depth_multiplier, self.kernel,
+                        strides=self.strides, padding=self.padding.upper(),
+                        feature_group_count=in_ch, use_bias=False,
+                        name="depthwise")(x)
+        point = nn.Conv(self.features, (1,) * len(self.kernel),
+                        use_bias=self.use_bias, name="pointwise")(depth)
+        return self.activation(point)
+
+
+class SeparableConvolution2D(KerasLayer):
+    """(ref: keras/layers/SeparableConvolution2D.scala)."""
+
+    def __init__(self, nb_filter, nb_row, nb_col=None, subsample=(1, 1),
+                 depth_multiplier: int = 1, activation=None,
+                 border_mode: str = "valid", bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.kernel = (nb_row, nb_col if nb_col is not None else nb_row)
+        self.subsample = _tup(subsample, 2)
+        self.depth_multiplier = depth_multiplier
+        self.activation = activations.get(activation)
+        self.border_mode = border_mode
+        self.bias = bias
+
+    def _make_module(self):
+        return _SeparableConv2DModule(
+            features=self.nb_filter, kernel=self.kernel,
+            strides=self.subsample, padding=self.border_mode,
+            depth_multiplier=self.depth_multiplier,
+            activation=self.activation, use_bias=self.bias)
+
+
+# -------------------------------------------------- crop / pad / upsample ---
+
+
+def _crop_layer(rank):
+    class _Cropping(KerasLayer):
+        def __init__(self, cropping=None, **kwargs):
+            super().__init__(**kwargs)
+            if cropping is None:
+                cropping = ((1, 1),) * rank if rank > 1 else (1, 1)
+            if rank == 1:
+                cropping = (tuple(cropping),)
+            self.cropping = tuple(tuple(c) for c in cropping)
+
+        def _make_module(self):
+            crops = self.cropping
+
+            def fn(x):
+                slices = [slice(None)]
+                for lo, hi in crops:
+                    slices.append(slice(lo, x.shape[len(slices)] - hi))
+                slices.append(slice(None))
+                return x[tuple(slices)]
+
+            return FnModule(fn=fn)
+
+    return _Cropping
+
+
+Cropping1D = _crop_layer(1)
+Cropping1D.__name__ = "Cropping1D"
+Cropping2D = _crop_layer(2)
+Cropping2D.__name__ = "Cropping2D"
+Cropping3D = _crop_layer(3)
+Cropping3D.__name__ = "Cropping3D"
+
+
+def _pad_layer(rank):
+    class _ZeroPadding(KerasLayer):
+        def __init__(self, padding=1, **kwargs):
+            super().__init__(**kwargs)
+            if isinstance(padding, int):
+                padding = ((padding, padding),) * rank
+            elif rank == 1 and isinstance(padding, (tuple, list)) and \
+                    len(padding) == 2 and isinstance(padding[0], int):
+                padding = (tuple(padding),)
+            else:
+                padding = tuple(
+                    (p, p) if isinstance(p, int) else tuple(p)
+                    for p in padding)
+            self.padding = padding
+
+        def _make_module(self):
+            pads = self.padding
+
+            def fn(x):
+                cfg = [(0, 0)] + list(pads) + [(0, 0)]
+                return jnp.pad(x, cfg)
+
+            return FnModule(fn=fn)
+
+    return _ZeroPadding
+
+
+ZeroPadding1D = _pad_layer(1)
+ZeroPadding1D.__name__ = "ZeroPadding1D"
+ZeroPadding2D = _pad_layer(2)
+ZeroPadding2D.__name__ = "ZeroPadding2D"
+ZeroPadding3D = _pad_layer(3)
+ZeroPadding3D.__name__ = "ZeroPadding3D"
+
+
+def _upsample_layer(rank):
+    class _UpSampling(KerasLayer):
+        def __init__(self, size=2, **kwargs):
+            super().__init__(**kwargs)
+            self.size = _tup(size, rank)
+
+        def _make_module(self):
+            size = self.size
+
+            def fn(x):
+                for axis, s in enumerate(size):
+                    x = jnp.repeat(x, s, axis=axis + 1)
+                return x
+
+            return FnModule(fn=fn)
+
+    return _UpSampling
+
+
+UpSampling1D = _upsample_layer(1)
+UpSampling1D.__name__ = "UpSampling1D"
+UpSampling2D = _upsample_layer(2)
+UpSampling2D.__name__ = "UpSampling2D"
+UpSampling3D = _upsample_layer(3)
+UpSampling3D.__name__ = "UpSampling3D"

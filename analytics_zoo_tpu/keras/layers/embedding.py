@@ -1,0 +1,91 @@
+"""Embedding layers (ref: zoo/.../keras/layers/{Embedding,WordEmbedding,
+SparseEmbedding}.scala)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras.layers.base import KerasLayer
+
+
+class _EmbedModule(nn.Module):
+    vocab: int
+    dim: int
+    init_weights: Optional[tuple] = None  # (np array wrapped) or None
+    trainable: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.init_weights is not None:
+            w = self.init_weights[0]
+            init = lambda *_: jnp.asarray(w)
+        else:
+            init = nn.initializers.uniform(scale=0.05)
+        table = self.param("embedding", init, (self.vocab, self.dim))
+        if not self.trainable:
+            table = jax.lax.stop_gradient(table)
+        return jnp.take(table, x.astype(jnp.int32), axis=0)
+
+
+class Embedding(KerasLayer):
+    """(ref: keras/layers/Embedding.scala). ids in [0, input_dim)."""
+
+    def __init__(self, input_dim: int, output_dim: int, weights=None,
+                 trainable: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.weights = weights
+        self.trainable = trainable
+
+    def _make_module(self):
+        init = None
+        if self.weights is not None:
+            w = np.asarray(self.weights, np.float32)
+            if w.shape != (self.input_dim, self.output_dim):
+                raise ValueError(
+                    f"weights shape {w.shape} != "
+                    f"{(self.input_dim, self.output_dim)}")
+            init = (w,)
+        return _EmbedModule(vocab=self.input_dim, dim=self.output_dim,
+                            init_weights=init, trainable=self.trainable)
+
+
+class WordEmbedding(Embedding):
+    """Pretrained word vectors, frozen by default
+    (ref: keras/layers/WordEmbedding.scala -- loads GloVe; here the
+    embedding matrix is passed directly or via ``from_glove``)."""
+
+    def __init__(self, input_dim: int, output_dim: int, weights=None,
+                 trainable: bool = False, **kwargs):
+        super().__init__(input_dim, output_dim, weights=weights,
+                         trainable=trainable, **kwargs)
+
+    @staticmethod
+    def from_glove(path: str, word_index: dict, trainable: bool = False
+                   ) -> "WordEmbedding":
+        """Build from a GloVe text file restricted to ``word_index``
+        (word -> id, ids in [1, n]; id 0 is the padding row)."""
+        dim = None
+        vectors = {}
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip().split(" ")
+                word = parts[0]
+                if word in word_index:
+                    vec = np.asarray(parts[1:], np.float32)
+                    dim = len(vec)
+                    vectors[word] = vec
+        if dim is None:
+            raise ValueError(f"no words of word_index found in {path!r}")
+        n = max(word_index.values()) + 1
+        table = np.zeros((n, dim), np.float32)
+        for w, i in word_index.items():
+            if w in vectors:
+                table[i] = vectors[w]
+        return WordEmbedding(n, dim, weights=table, trainable=trainable)

@@ -1,0 +1,92 @@
+"""Merge layers (ref: zoo/.../keras/layers/Merge.scala -- modes sum/mul/
+max/ave/concat/dot/cos; keras functional merge helpers)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.layers.base import KerasLayer
+
+
+class _MergeModule(nn.Module):
+    mode: str
+    concat_axis: int
+    dot_axes: int
+
+    @nn.compact
+    def __call__(self, xs, train: bool = False):
+        if not isinstance(xs, (list, tuple)):
+            raise ValueError("Merge expects a list of inputs")
+        mode = self.mode
+        if mode == "concat":
+            return jnp.concatenate(list(xs), axis=self.concat_axis)
+        if mode == "sum":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+        if mode == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if mode == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if mode == "ave":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out / len(xs)
+        if mode == "dot":
+            a, b = xs
+            return jnp.sum(a * b, axis=self.dot_axes, keepdims=True)
+        if mode == "cos":
+            a, b = xs
+            na = jnp.linalg.norm(a, axis=self.dot_axes, keepdims=True)
+            nb = jnp.linalg.norm(b, axis=self.dot_axes, keepdims=True)
+            return (jnp.sum(a * b, axis=self.dot_axes, keepdims=True)
+                    / jnp.maximum(na * nb, 1e-7))
+        raise ValueError(f"unknown merge mode {mode!r}")
+
+
+class Merge(KerasLayer):
+    def __init__(self, mode: str = "sum", concat_axis: int = -1,
+                 dot_axes: int = -1, **kwargs):
+        super().__init__(**kwargs)
+        self.mode = mode
+        self.concat_axis = concat_axis
+        self.dot_axes = dot_axes
+
+    def _make_module(self):
+        return _MergeModule(mode=self.mode, concat_axis=self.concat_axis,
+                            dot_axes=self.dot_axes)
+
+
+def concatenate(tensors: Sequence, axis: int = -1):
+    return Merge(mode="concat", concat_axis=axis)(list(tensors))
+
+
+def add(tensors: Sequence):
+    return Merge(mode="sum")(list(tensors))
+
+
+def multiply(tensors: Sequence):
+    return Merge(mode="mul")(list(tensors))
+
+
+def average(tensors: Sequence):
+    return Merge(mode="ave")(list(tensors))
+
+
+def maximum(tensors: Sequence):
+    return Merge(mode="max")(list(tensors))
+
+
+def dot(tensors: Sequence, axes: int = -1):
+    return Merge(mode="dot", dot_axes=axes)(list(tensors))

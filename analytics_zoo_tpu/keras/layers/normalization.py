@@ -1,0 +1,53 @@
+"""Normalization layers (ref: zoo/.../keras/layers/BatchNormalization.scala,
+zoo/.../keras/layers/internal LayerNorm used by Transformer/BERT)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from analytics_zoo_tpu.keras.layers.base import KerasLayer
+
+
+class _BatchNormModule(nn.Module):
+    momentum: float
+    epsilon: float
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.BatchNorm(use_running_average=not train,
+                            momentum=self.momentum,
+                            epsilon=self.epsilon)(x)
+
+
+class BatchNormalization(KerasLayer):
+    """(ref: keras/layers/BatchNormalization.scala; running stats live in
+    the ``batch_stats`` collection the Estimator threads through)."""
+
+    def __init__(self, momentum: float = 0.99, epsilon: float = 1e-3,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.epsilon = epsilon
+
+    def _make_module(self):
+        return _BatchNormModule(momentum=self.momentum,
+                                epsilon=self.epsilon)
+
+
+class _LayerNormModule(nn.Module):
+    epsilon: float
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.LayerNorm(epsilon=self.epsilon)(x)
+
+
+class LayerNormalization(KerasLayer):
+    """(ref: TransformerLayer.scala's internal LayerNorm)."""
+
+    def __init__(self, epsilon: float = 1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.epsilon = epsilon
+
+    def _make_module(self):
+        return _LayerNormModule(epsilon=self.epsilon)

@@ -1,0 +1,70 @@
+"""Pooling layers (ref: zoo/.../keras/layers/{MaxPooling*,AveragePooling*,
+GlobalMaxPooling*,GlobalAveragePooling*}.scala). Channels-last layouts."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.layers.base import FnModule, KerasLayer
+from analytics_zoo_tpu.keras.layers.convolutional import _tup
+
+
+def _pool_layer(rank, op):
+    class _Pool(KerasLayer):
+        def __init__(self, pool_size=2, strides=None,
+                     border_mode: str = "valid", **kwargs):
+            super().__init__(**kwargs)
+            self.pool_size = _tup(pool_size, rank)
+            self.strides = (_tup(strides, rank) if strides is not None
+                            else self.pool_size)
+            self.border_mode = border_mode.upper()
+
+        def _make_module(self):
+            ps, st, pad = self.pool_size, self.strides, self.border_mode
+            if op == "max":
+                fn = lambda x: nn.max_pool(x, ps, strides=st, padding=pad)
+            else:
+                fn = lambda x: nn.avg_pool(x, ps, strides=st, padding=pad)
+            return FnModule(fn=fn)
+
+    return _Pool
+
+
+MaxPooling1D = _pool_layer(1, "max")
+MaxPooling1D.__name__ = "MaxPooling1D"
+MaxPooling2D = _pool_layer(2, "max")
+MaxPooling2D.__name__ = "MaxPooling2D"
+MaxPooling3D = _pool_layer(3, "max")
+MaxPooling3D.__name__ = "MaxPooling3D"
+AveragePooling1D = _pool_layer(1, "avg")
+AveragePooling1D.__name__ = "AveragePooling1D"
+AveragePooling2D = _pool_layer(2, "avg")
+AveragePooling2D.__name__ = "AveragePooling2D"
+AveragePooling3D = _pool_layer(3, "avg")
+AveragePooling3D.__name__ = "AveragePooling3D"
+
+
+def _global_pool_layer(rank, op):
+    class _GlobalPool(KerasLayer):
+        def _make_module(self):
+            axes = tuple(range(1, rank + 1))
+            if op == "max":
+                return FnModule(fn=lambda x: jnp.max(x, axis=axes))
+            return FnModule(fn=lambda x: jnp.mean(x, axis=axes))
+
+    return _GlobalPool
+
+
+GlobalMaxPooling1D = _global_pool_layer(1, "max")
+GlobalMaxPooling1D.__name__ = "GlobalMaxPooling1D"
+GlobalMaxPooling2D = _global_pool_layer(2, "max")
+GlobalMaxPooling2D.__name__ = "GlobalMaxPooling2D"
+GlobalMaxPooling3D = _global_pool_layer(3, "max")
+GlobalMaxPooling3D.__name__ = "GlobalMaxPooling3D"
+GlobalAveragePooling1D = _global_pool_layer(1, "avg")
+GlobalAveragePooling1D.__name__ = "GlobalAveragePooling1D"
+GlobalAveragePooling2D = _global_pool_layer(2, "avg")
+GlobalAveragePooling2D.__name__ = "GlobalAveragePooling2D"
+GlobalAveragePooling3D = _global_pool_layer(3, "avg")
+GlobalAveragePooling3D.__name__ = "GlobalAveragePooling3D"

@@ -1,0 +1,152 @@
+"""Recurrent layers (ref: zoo/.../keras/layers/{LSTM,GRU,SimpleRNN,
+ConvLSTM2D,Bidirectional,TimeDistributed}.scala).
+
+Implemented over flax's scan-based RNN machinery -- on TPU the recurrence
+compiles to a single fused ``lax.scan`` loop (no per-step dispatch, unlike
+the reference's per-timestep BigDL module calls)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.layers.base import KerasLayer
+
+
+class _RNNModule(nn.Module):
+    cell_type: str
+    units: int
+    return_sequences: bool
+    reverse: bool = False
+    conv_kernel: Optional[Tuple[int, int]] = None
+
+    def _cell(self):
+        if self.cell_type == "lstm":
+            return nn.OptimizedLSTMCell(self.units)
+        if self.cell_type == "gru":
+            return nn.GRUCell(self.units)
+        if self.cell_type == "simple":
+            return nn.SimpleCell(self.units)
+        if self.cell_type == "convlstm2d":
+            return nn.ConvLSTMCell(self.units, self.conv_kernel)
+        raise ValueError(self.cell_type)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        seq = nn.RNN(self._cell(), reverse=self.reverse,
+                     keep_order=True)(x)
+        if self.return_sequences:
+            return seq
+        return seq[:, -1 if not self.reverse else 0]
+
+
+class _RecurrentBase(KerasLayer):
+    cell_type = "simple"
+
+    def __init__(self, output_dim: int, return_sequences: bool = False,
+                 go_backwards: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.output_dim = output_dim
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def _make_module(self):
+        return _RNNModule(cell_type=self.cell_type, units=self.output_dim,
+                          return_sequences=self.return_sequences,
+                          reverse=self.go_backwards)
+
+
+class SimpleRNN(_RecurrentBase):
+    cell_type = "simple"
+
+
+class LSTM(_RecurrentBase):
+    cell_type = "lstm"
+
+
+class GRU(_RecurrentBase):
+    cell_type = "gru"
+
+
+class ConvLSTM2D(KerasLayer):
+    """x: [B, T, H, W, C] (ref: keras/layers/ConvLSTM2D.scala;
+    channels-last)."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int,
+                 return_sequences: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.nb_kernel = nb_kernel
+        self.return_sequences = return_sequences
+
+    def _make_module(self):
+        return _RNNModule(cell_type="convlstm2d", units=self.nb_filter,
+                          return_sequences=self.return_sequences,
+                          conv_kernel=(self.nb_kernel, self.nb_kernel))
+
+
+class _BidirectionalModule(nn.Module):
+    fwd: nn.Module
+    bwd: nn.Module
+    merge_mode: str
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        f = self.fwd(x, train=train)
+        b = self.bwd(x, train=train)
+        if self.merge_mode == "concat":
+            return jnp.concatenate([f, b], axis=-1)
+        if self.merge_mode == "sum":
+            return f + b
+        if self.merge_mode == "mul":
+            return f * b
+        if self.merge_mode == "ave":
+            return (f + b) / 2.0
+        raise ValueError(self.merge_mode)
+
+
+class Bidirectional(KerasLayer):
+    """(ref: keras/layers/Bidirectional.scala)."""
+
+    def __init__(self, layer: _RecurrentBase, merge_mode: str = "concat",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def _make_module(self):
+        fwd = _RNNModule(cell_type=self.layer.cell_type,
+                         units=self.layer.output_dim,
+                         return_sequences=self.layer.return_sequences,
+                         reverse=False)
+        bwd = _RNNModule(cell_type=self.layer.cell_type,
+                         units=self.layer.output_dim,
+                         return_sequences=self.layer.return_sequences,
+                         reverse=True)
+        return _BidirectionalModule(fwd=fwd, bwd=bwd,
+                                    merge_mode=self.merge_mode)
+
+
+class _TimeDistributedModule(nn.Module):
+    inner: nn.Module
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        out = self.inner(flat, train=train)
+        return out.reshape((b, t) + out.shape[1:])
+
+
+class TimeDistributed(KerasLayer):
+    """Apply a layer to every timestep with shared weights
+    (ref: keras/layers/TimeDistributed.scala)."""
+
+    def __init__(self, layer: KerasLayer, **kwargs):
+        super().__init__(**kwargs)
+        self.layer = layer
+
+    def _make_module(self):
+        return _TimeDistributedModule(inner=self.layer.build())

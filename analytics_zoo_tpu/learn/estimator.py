@@ -39,14 +39,17 @@ from analytics_zoo_tpu.parallel.sharding import replicated
 logger = get_logger(__name__)
 
 
-def _as_dataset(data, batch_size=None) -> ZooDataset:
+def _as_dataset(data, labeled: bool = True) -> ZooDataset:
+    """Coerce to ZooDataset. ``labeled=True`` splits a 2-tuple into
+    (features, labels); predict paths pass ``labeled=False`` so a tuple is
+    a multi-input feature pytree."""
     if isinstance(data, ZooDataset):
         return data
     from analytics_zoo_tpu.data.shard import XShards
 
     if isinstance(data, XShards):
         return ZooDataset.from_xshards(data)
-    if isinstance(data, tuple) and len(data) == 2:
+    if labeled and isinstance(data, tuple) and len(data) == 2:
         return ZooDataset.from_ndarrays(data[0], data[1])
     return ZooDataset.from_ndarrays(data)
 
@@ -176,7 +179,7 @@ class Estimator:
             logger.info("model built: %d parameters", int(n_params))
             newly_placed = True
         if self.opt_state is None:
-            self.opt_state = self.tx.init(self.variables["params"])
+            self.opt_state = self.tx.init(self.variables.get("params", {}))
             newly_placed = True
         if newly_placed:
             self._place_state()
@@ -209,7 +212,7 @@ class Estimator:
         donate = get_config().get("zoo.train.donate_buffers")
 
         def step(variables, opt_state, x, y, rng):
-            params = variables["params"]
+            params = variables.get("params", {})
             extra = {k: v for k, v in variables.items() if k != "params"}
 
             def compute_loss(p):
@@ -419,7 +422,7 @@ class Estimator:
 
     # ----------------------------------------------------------- predict --
     def predict(self, data, batch_size: int = 32) -> Any:
-        dataset = _as_dataset(data)
+        dataset = _as_dataset(data, labeled=False)
         self._ensure_built(self._probe_example(dataset, batch_size))
         adapter = self.adapter
 
@@ -459,7 +462,7 @@ class Estimator:
         if self.variables is None:
             raise ValueError("nothing to save: model not built")
         if self.opt_state is None:
-            self.opt_state = self.tx.init(self.variables["params"])
+            self.opt_state = self.tx.init(self.variables.get("params", {}))
 
     def load(self, ckpt_dir: str) -> None:
         if self.variables is None:

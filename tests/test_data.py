@@ -170,3 +170,21 @@ class TestSources:
         merged = sh.merged()
         assert merged["x"].shape == (6, 8, 10, 3)
         assert sorted(merged["y"].tolist()) == [0, 0, 0, 1, 1, 1]
+
+
+class TestDiskSplitRegression:
+    def test_disk_split_preserves_features_and_labels(self, tmp_path):
+        x = np.arange(80, dtype=np.float32).reshape(40, 2)
+        y = np.arange(40, dtype=np.int64) + 1000
+        from analytics_zoo_tpu.data import ZooDataset
+
+        ds = ZooDataset(x, y, memory_type="DISK", cache_dir=str(tmp_path))
+        tr, va = ds.split(0.5, seed=0)
+        # features and labels must still correspond after the split
+        all_x = np.concatenate([np.asarray(tr.features),
+                                np.asarray(va.features)])
+        all_y = np.concatenate([np.asarray(tr.labels),
+                                np.asarray(va.labels)])
+        for xi, yi in zip(all_x, all_y):
+            row = int(yi - 1000)
+            np.testing.assert_allclose(xi, x[row])

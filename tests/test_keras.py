@@ -1,0 +1,241 @@
+"""Keras API tests: layer numerics (golden values), Sequential training,
+functional graph Model -- the analog of the reference's KerasBaseSpec
+golden tests vs real Keras (ref: zoo/src/test/scala/.../KerasRunner.scala)."""
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.keras as K
+from analytics_zoo_tpu.keras import Input, Model, Sequential
+from analytics_zoo_tpu.keras.layers import (
+    Activation, AveragePooling2D, BatchNormalization, Bidirectional,
+    Convolution1D, Convolution2D, Cropping2D, Dense, Dropout, ELU,
+    Embedding, Flatten, GRU, GlobalAveragePooling2D, GlobalMaxPooling1D,
+    Highway, LSTM, LayerNormalization, LeakyReLU, Merge, MaxPooling2D,
+    PReLU, Permute, RepeatVector, Reshape, SReLU, SeparableConvolution2D,
+    SimpleRNN, TimeDistributed, UpSampling2D, WordEmbedding, ZeroPadding2D,
+    concatenate, Deconvolution2D,
+)
+
+
+def apply_layer(layer, x, train=False, rng_seed=0):
+    """Init + apply a single layer module on concrete data."""
+    import jax
+
+    m = layer.build()
+    rng = jax.random.PRNGKey(rng_seed)
+    variables = m.init({"params": rng, "dropout": rng}, x)
+    if train:
+        out = m.apply(variables, x, train=True,
+                      rngs={"dropout": jax.random.PRNGKey(1)},
+                      mutable=[c for c in variables if c != "params"])
+        return np.asarray(out[0] if isinstance(out, tuple) else out)
+    return np.asarray(m.apply(variables, x))
+
+
+class TestShapes:
+    def test_dense_activation(self):
+        x = np.ones((2, 4), np.float32)
+        out = apply_layer(Dense(8, activation="relu"), x)
+        assert out.shape == (2, 8)
+        assert (out >= 0).all()
+
+    def test_conv_pool_stack_shapes(self):
+        x = np.random.randn(2, 16, 16, 3).astype(np.float32)
+        assert apply_layer(Convolution2D(8, 3, border_mode="same"),
+                           x).shape == (2, 16, 16, 8)
+        assert apply_layer(Convolution2D(8, 3), x).shape == (2, 14, 14, 8)
+        assert apply_layer(MaxPooling2D(), x).shape == (2, 8, 8, 3)
+        assert apply_layer(AveragePooling2D(pool_size=4),
+                           x).shape == (2, 4, 4, 3)
+        assert apply_layer(GlobalAveragePooling2D(), x).shape == (2, 3)
+        assert apply_layer(ZeroPadding2D(2), x).shape == (2, 20, 20, 3)
+        assert apply_layer(Cropping2D(((2, 2), (3, 3))),
+                           x).shape == (2, 12, 10, 3)
+        assert apply_layer(UpSampling2D(2), x).shape == (2, 32, 32, 3)
+        assert apply_layer(SeparableConvolution2D(6, 3),
+                           x).shape == (2, 14, 14, 6)
+        assert apply_layer(Deconvolution2D(4, 3, subsample=(2, 2),
+                                           border_mode="same"),
+                           x).shape == (2, 32, 32, 4)
+
+    def test_conv1d_and_global(self):
+        x = np.random.randn(2, 10, 4).astype(np.float32)
+        assert apply_layer(Convolution1D(6, 3), x).shape == (2, 8, 6)
+        assert apply_layer(GlobalMaxPooling1D(), x).shape == (2, 4)
+
+    def test_core_reshapers(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        assert apply_layer(Flatten(), x).shape == (2, 12)
+        assert apply_layer(Reshape((4, 3)), x).shape == (2, 4, 3)
+        assert apply_layer(Permute((2, 1)), x).shape == (2, 4, 3)
+        v = np.ones((2, 5), np.float32)
+        assert apply_layer(RepeatVector(3), v).shape == (2, 3, 5)
+
+    def test_rnn_family_shapes(self):
+        x = np.random.randn(2, 7, 5).astype(np.float32)
+        assert apply_layer(LSTM(6), x).shape == (2, 6)
+        assert apply_layer(LSTM(6, return_sequences=True),
+                           x).shape == (2, 7, 6)
+        assert apply_layer(GRU(4), x).shape == (2, 4)
+        assert apply_layer(SimpleRNN(3), x).shape == (2, 3)
+        assert apply_layer(Bidirectional(LSTM(6)), x).shape == (2, 12)
+        assert apply_layer(TimeDistributed(Dense(9)),
+                           x).shape == (2, 7, 9)
+
+    def test_embedding(self):
+        ids = np.array([[1, 2], [3, 0]], np.int32)
+        out = apply_layer(Embedding(10, 4), ids)
+        assert out.shape == (2, 2, 4)
+        w = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = apply_layer(WordEmbedding(3, 4, weights=w), ids % 3)
+        np.testing.assert_allclose(out[0, 0], w[1])
+
+
+class TestGoldenNumerics:
+    def test_activation_values(self):
+        x = np.asarray([[-1.0, 0.0, 2.0]], np.float32)
+        np.testing.assert_allclose(
+            apply_layer(Activation("relu"), x), [[0, 0, 2]])
+        np.testing.assert_allclose(
+            apply_layer(LeakyReLU(0.1), x), [[-0.1, 0, 2]], atol=1e-6)
+        np.testing.assert_allclose(
+            apply_layer(Activation("hard_sigmoid"), x),
+            [[0.3, 0.5, 0.9]], atol=1e-6)
+        np.testing.assert_allclose(
+            apply_layer(ELU(1.0), x),
+            [[np.expm1(-1.0), 0, 2]], atol=1e-6)
+        np.testing.assert_allclose(
+            apply_layer(PReLU(), x), [[-0.25, 0, 2]], atol=1e-6)
+
+    def test_srelu_identity_in_band(self):
+        # default params: t_l=0, a_l=0.2, t_r=1, a_r=1 -> identity on [0,1]
+        x = np.asarray([[0.5, -1.0, 3.0]], np.float32)
+        out = apply_layer(SReLU(), x)
+        np.testing.assert_allclose(out, [[0.5, -0.2, 3.0]], atol=1e-6)
+
+    def test_layernorm_zero_mean_unit_var(self):
+        x = np.random.randn(4, 8).astype(np.float32) * 5 + 3
+        out = apply_layer(LayerNormalization(), x)
+        np.testing.assert_allclose(out.mean(-1), 0, atol=1e-4)
+        np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+    def test_batchnorm_train_normalizes(self):
+        x = (np.random.randn(64, 4) * 3 + 7).astype(np.float32)
+        out = apply_layer(BatchNormalization(), x, train=True)
+        np.testing.assert_allclose(out.mean(0), 0, atol=1e-2)
+        np.testing.assert_allclose(out.std(0), 1, atol=5e-2)
+
+    def test_merge_modes(self):
+        a = np.asarray([[1.0, 2.0]], np.float32)
+        b = np.asarray([[3.0, 5.0]], np.float32)
+        for mode, want in [("sum", [[4, 7]]), ("mul", [[3, 10]]),
+                           ("max", [[3, 5]]), ("ave", [[2, 3.5]])]:
+            m = Merge(mode=mode).build()
+            import jax
+
+            var = m.init(jax.random.PRNGKey(0), [a, b])
+            np.testing.assert_allclose(
+                np.asarray(m.apply(var, [a, b])), want)
+
+    def test_highway_carry_behavior(self):
+        # gate bias -2 -> mostly carry at init: output close to input
+        x = np.random.randn(4, 6).astype(np.float32)
+        out = apply_layer(Highway(), x)
+        assert np.abs(out - x).mean() < np.abs(x).mean()
+
+    def test_dropout_train_vs_eval(self):
+        x = np.ones((4, 100), np.float32)
+        out_eval = apply_layer(Dropout(0.5), x, train=False)
+        np.testing.assert_allclose(out_eval, x)
+        out_train = apply_layer(Dropout(0.5), x, train=True)
+        assert (out_train == 0).mean() > 0.2
+
+
+class TestSequentialTraining:
+    def test_mnist_style_mlp(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(256, 10).astype(np.float32)
+        y = (x[:, :3].sum(1) > 0).astype(np.int32)
+        model = Sequential()
+        model.add(Dense(16, activation="relu"))
+        model.add(Dropout(0.2))
+        model.add(Dense(2))
+        from analytics_zoo_tpu.learn import Adam
+
+        model.compile(optimizer=Adam(1e-2),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        hist = model.fit(x, y, batch_size=64, nb_epoch=15)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        res = model.evaluate(x, y, batch_size=64)
+        assert res["accuracy"] > 0.8
+        preds = model.predict(x[:50], batch_size=32)
+        assert preds.shape == (50, 2)
+
+    def test_cnn_trains(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 8, 8, 1).astype(np.float32)
+        y = (x.mean((1, 2, 3)) > 0).astype(np.int32)
+        model = Sequential([
+            Convolution2D(4, 3, activation="relu", border_mode="same"),
+            MaxPooling2D(),
+            Flatten(),
+            Dense(2),
+        ])
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy")
+        hist = model.fit(x, y, batch_size=32, nb_epoch=5)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_lstm_trains(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 6, 4).astype(np.float32)
+        y = (x[:, -1, 0] > 0).astype(np.int32)
+        model = Sequential([LSTM(8), Dense(2)])
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy")
+        hist = model.fit(x, y, batch_size=32, nb_epoch=6)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+class TestGraphModel:
+    def test_two_branch_graph(self):
+        a = Input((4,))
+        b = Input((6,))
+        ha = Dense(8, activation="relu")(a)
+        hb = Dense(8, activation="relu")(b)
+        merged = concatenate([ha, hb])
+        out = Dense(2)(merged)
+        model = Model(input=[a, b], output=out)
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy")
+        rng = np.random.RandomState(0)
+        xa = rng.randn(128, 4).astype(np.float32)
+        xb = rng.randn(128, 6).astype(np.float32)
+        y = ((xa[:, 0] + xb[:, 0]) > 0).astype(np.int32)
+        hist = model.fit((xa, xb), y, batch_size=32, nb_epoch=6)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        preds = model.predict((xa, xb), batch_size=32)
+        assert preds.shape == (128, 2)
+
+    def test_autograd_arithmetic_sugar(self):
+        a = Input((3,))
+        b = Input((3,))
+        out = a * 2.0 + b - 1.0
+        model = Model(input=[a, b], output=out)
+        xa = np.ones((8, 3), np.float32)
+        xb = np.full((8, 3), 5.0, np.float32)
+        preds = model.predict((xa, xb), batch_size=8)
+        np.testing.assert_allclose(preds, np.full((8, 3), 6.0))
+
+    def test_shared_layer_diamond(self):
+        inp = Input((4,))
+        shared = Dense(4, activation="tanh")
+        h1 = shared(inp)
+        h2 = shared(inp)  # same layer twice: diamond
+        out = Merge(mode="sum")([h1, h2])
+        model = Model(input=inp, output=out)
+        x = np.random.randn(8, 4).astype(np.float32)
+        preds = model.predict(x, batch_size=8)
+        assert preds.shape == (8, 4)
