@@ -176,15 +176,10 @@ class KerasNet:
         """(ref: Topology.scala compile). Recompiling preserves trained
         weights (Keras contract)."""
         self._optimizer, self._loss, self._metrics = optimizer, loss, metrics
-        from analytics_zoo_tpu.learn.estimator import Estimator
+        from analytics_zoo_tpu.learn.estimator import recompiled
 
-        old = self.estimator
-        self.estimator = Estimator(
-            self.module, loss=loss, optimizer=optimizer, metrics=metrics,
-            variables=old.variables if old is not None else None)
-        if old is not None:
-            self.estimator.global_step = old.global_step
-            self.estimator.epoch = old.epoch
+        self.estimator = recompiled(self.estimator, self.module, loss=loss,
+                                    optimizer=optimizer, metrics=metrics)
         return self
 
     def set_checkpoint(self, path: str, over_write: bool = True,

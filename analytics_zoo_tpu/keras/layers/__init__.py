@@ -78,3 +78,10 @@ from analytics_zoo_tpu.keras.layers.advanced_activations import (  # noqa: F401
     PReLU,
     ThresholdedReLU,
 )
+from analytics_zoo_tpu.keras.layers.transformer import (  # noqa: F401
+    BERT,
+    BERTModule,
+    TransformerBlock,
+    TransformerLayer,
+    TransformerModule,
+)

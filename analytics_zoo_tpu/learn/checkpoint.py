@@ -62,24 +62,33 @@ def load_checkpoint(ckpt_dir: str, variables_template: Any,
                     step: Optional[int] = None
                     ) -> Tuple[Any, Any, Dict]:
     """Restore (variables, opt_state, meta); templates supply the pytree
-    structure (flax msgpack is structure-less on disk)."""
+    structure (flax msgpack is structure-less on disk). A None
+    ``variables_template`` restores the raw dict tree (model variables
+    are plain nested dicts, so no template is needed)."""
     if step is None:
         step = latest_step(ckpt_dir)
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
     with open(os.path.join(ckpt_dir, f"model.{step}"), "rb") as f:
-        variables = serialization.from_bytes(
-            jax.device_get(variables_template), f.read())
-    with open(os.path.join(ckpt_dir, f"optim.{step}"), "rb") as f:
-        try:
-            opt_state = serialization.from_bytes(
-                jax.device_get(opt_state_template), f.read())
-        except ValueError as e:
-            raise ValueError(
-                "optimizer state in the checkpoint does not match this "
-                "Estimator's optimizer config (optimizer type and "
-                "clip_norm/clip_value must match the run that saved it): "
-                f"{e}") from e
+        data = f.read()
+        if variables_template is None:
+            variables = serialization.msgpack_restore(data)
+        else:
+            variables = serialization.from_bytes(
+                jax.device_get(variables_template), data)
+    if opt_state_template is None:
+        opt_state = None  # caller only wants model variables
+    else:
+        with open(os.path.join(ckpt_dir, f"optim.{step}"), "rb") as f:
+            try:
+                opt_state = serialization.from_bytes(
+                    jax.device_get(opt_state_template), f.read())
+            except ValueError as e:
+                raise ValueError(
+                    "optimizer state in the checkpoint does not match this "
+                    "Estimator's optimizer config (optimizer type and "
+                    "clip_norm/clip_value must match the run that saved "
+                    f"it): {e}") from e
     with open(os.path.join(ckpt_dir, f"meta.{step}.json")) as f:
         meta = json.load(f)
     logger.info("checkpoint restored: %s step=%d", ckpt_dir, step)

@@ -465,12 +465,27 @@ class Estimator:
             self.opt_state = self.tx.init(self.variables.get("params", {}))
 
     def load(self, ckpt_dir: str) -> None:
+        """Restore weights; works on an un-built Estimator (the model
+        variables restore template-free, then the optimizer state restores
+        against a fresh tx.init template)."""
         if self.variables is None:
-            raise ValueError(
-                "build the model first (fit/evaluate/predict once or pass "
-                "variables=) so load has a pytree template")
+            self.variables, _, _ = ckpt_lib.load_checkpoint(ckpt_dir, None,
+                                                            None)
         self._ensure_opt_for_save()
         self._restore(ckpt_dir)
+
+
+def recompiled(old: Optional["Estimator"], model, **kwargs) -> "Estimator":
+    """Build a fresh Estimator carrying over trained weights + counters
+    from ``old`` (the Keras compile() contract: recompiling changes the
+    training config, not the model)."""
+    est = Estimator(model,
+                    variables=old.variables if old is not None else None,
+                    **kwargs)
+    if old is not None:
+        est.global_step = old.global_step
+        est.epoch = old.epoch
+    return est
 
 
 def _is_flax_module(obj) -> bool:

@@ -48,18 +48,15 @@ class ZooModel:
     def compile(self, loss=None, optimizer=None, metrics=None, **kwargs):
         """Re-configure the training engine (Keras-style); trained weights
         carry over (recompiling changes the optimizer, not the model)."""
-        old = self.estimator
-        self.estimator = Estimator(
-            self.module,
+        from analytics_zoo_tpu.learn.estimator import recompiled
+
+        self.estimator = recompiled(
+            self.estimator, self.module,
             loss=loss if loss is not None else self.default_loss,
             optimizer=(optimizer if optimizer is not None
                        else self.default_optimizer),
             metrics=metrics if metrics is not None else self.default_metrics,
-            variables=old.variables if old is not None else None,
             **kwargs)
-        if old is not None:
-            self.estimator.global_step = old.global_step
-            self.estimator.epoch = old.epoch
         return self
 
     def fit(self, data, batch_size: int = 256, epochs: int = 1, **kwargs):

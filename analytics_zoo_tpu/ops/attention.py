@@ -1,0 +1,106 @@
+"""Attention dispatch: Pallas flash kernels on TPU, jnp reference on CPU.
+
+Replaces the reference's O(L^2)-materialized attention
+(ref: zoo/.../keras/layers/TransformerLayer.scala attn -- builds the full
+[B, H, L, L] score matrix through BigDL ops). On TPU the flash kernels
+never materialize scores in HBM:
+
+- head_dim % 128 == 0 -> the framework's own Pallas kernel
+  (``pallas_attention.pallas_flash_attention_fwd``, exact custom_vjp);
+- otherwise (e.g. BERT-base head_dim 64) -> the stock fused fwd+bwd
+  kernel, with key-padding masks lowered to segment ids.
+
+The jnp reference path handles CPU, arbitrary 4-D masks, and attention
+dropout (flash kernels don't support prob dropout -- same trade-off every
+flash implementation makes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, mask=None, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Exact jnp attention; the single source of truth the Pallas kernels
+    are tested against and the custom_vjp backward recomputes through."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = q.shape[2], k.shape[2]
+        cm = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        logits = jnp.where(cm[None, None], logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _platform(q) -> str:
+    try:
+        dev = q.devices() if hasattr(q, "devices") else None
+        return list(dev)[0].platform if dev else jax.default_backend()
+    except Exception:
+        return jax.default_backend()
+
+
+def dot_product_attention(q, k, v, mask=None, key_padding_mask=None,
+                          causal: bool = False,
+                          scale: Optional[float] = None,
+                          dropout_rate: float = 0.0, dropout_rng=None):
+    """q,k,v: [B, H, L, D]. ``mask``: arbitrary [B, H, Lq, Lk]-broadcastable
+    (1 = attend; forces the jnp path). ``key_padding_mask``: [B, Lk] with
+    1 = real token -- flash-compatible (lowered to segment ids).
+    Returns [B, H, Lq, D]."""
+    d = q.shape[-1]
+    l, lk = q.shape[2], k.shape[2]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+
+    flash_ok = (mask is None and dropout_rate == 0.0
+                and _platform(q) == "tpu"
+                and l % 128 == 0 and lk % 128 == 0)
+    if flash_ok and d % 128 == 0:
+        from analytics_zoo_tpu.ops.pallas_attention import (
+            pallas_flash_attention_fwd)
+
+        if key_padding_mask is None:
+            return pallas_flash_attention_fwd(q, k, v, causal, scale)
+        flash_ok = True  # fall through to stock kernel for padding masks
+    if flash_ok and d <= 128:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            SegmentIds, flash_attention)
+
+        seg = None
+        if key_padding_mask is not None:
+            kv_seg = key_padding_mask.astype(jnp.int32)
+            q_seg = (kv_seg if lk == l
+                     else jnp.ones((q.shape[0], l), jnp.int32))
+            seg = SegmentIds(q=q_seg, kv=kv_seg)
+        return flash_attention(q, k, v, segment_ids=seg, causal=causal,
+                               sm_scale=scale)
+
+    if key_padding_mask is not None:
+        pm = key_padding_mask[:, None, None, :].astype(bool)
+        mask = pm if mask is None else (mask.astype(bool) & pm)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        # dropout needs the materialized probs; inline the reference math
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if causal:
+            cm = jnp.tril(jnp.ones((l, lk), bool), k=lk - l)
+            logits = jnp.where(cm[None, None], logits, NEG_INF)
+        if mask is not None:
+            logits = jnp.where(mask.astype(bool), logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return reference_attention(q, k, v, mask=mask, causal=causal,
+                               scale=scale)

@@ -1,0 +1,147 @@
+"""Hand-written Pallas TPU flash-attention (forward) kernel.
+
+The fused attention hot op for inference and the building block the
+framework owns end-to-end (training additionally uses the stock fused
+fwd+bwd kernel via ``ops.attention``). Blockwise online-softmax: the grid
+walks (batch*heads, q-blocks, kv-blocks) with the kv dimension innermost;
+running (max, sum, acc) live in VMEM scratch across kv iterations, so the
+[L, L] score matrix never exists in HBM.
+
+Gradients: wrapped in ``custom_vjp`` whose backward recomputes through
+the jnp reference path (exact; flash backward kernel is future work).
+
+Constraints: seq % block == 0, head_dim % 128 == 0 (MXU lane tiling);
+callers fall back to the jnp path otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                      causal: bool, scale: float, block_q: int,
+                      block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # skip fully-masked kv blocks under causal masking
+    run = True if not causal else (ki * block_k <= qi * block_q +
+                                   (block_q - 1))
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)          # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)          # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                     # [BQ, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                    # [BQ, BK]
+        corr = jnp.exp(m_prev - m_new)            # [BQ, 1]
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc_scr[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[...] = acc
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
+               block_k: int):
+    b, h, l, d = q.shape
+    lk = k.shape[2]
+    if l % block_q or lk % block_k:
+        raise ValueError(f"seq lens ({l},{lk}) must divide blocks "
+                         f"({block_q},{block_k})")
+    if d % 128:
+        raise ValueError(f"head_dim {d} must be a multiple of 128")
+    qr = q.reshape(b * h, l, d)
+    kr = k.reshape(b * h, lk, d)
+    vr = v.reshape(b * h, lk, d)
+    grid = (b * h, l // block_q, lk // block_k)
+    # interpret mode runs the kernel logic on CPU (tests); compiled on TPU
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, l, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def pallas_flash_attention_fwd(q, k, v, causal: bool = False,
+                               scale: Optional[float] = None,
+                               block_q: int = 128, block_k: int = 128):
+    """Flash attention on [B, H, L, D]; exact softmax attention."""
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+
+
+def _vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    out = pallas_flash_attention_fwd(q, k, v, causal, scale, block_q,
+                                     block_k)
+    return out, (q, k, v)
+
+
+def _vjp_bwd(causal, scale, block_q, block_k, res, g):
+    from analytics_zoo_tpu.ops.attention import reference_attention
+
+    q, k, v = res
+    s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    _, vjp = jax.vjp(
+        lambda a, b, c: reference_attention(a, b, c, causal=causal,
+                                            scale=s).astype(a.dtype),
+        q, k, v)
+    return vjp(g)
+
+
+pallas_flash_attention_fwd.defvjp(_vjp_fwd, _vjp_bwd)
