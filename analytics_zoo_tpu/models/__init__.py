@@ -10,8 +10,19 @@ object detection.
 
 from analytics_zoo_tpu.models.common import ZooModel  # noqa: F401
 from analytics_zoo_tpu.models.recommendation import (  # noqa: F401
+    ColumnFeatureInfo,
     NeuralCF,
     Recommender,
+    SessionRecommender,
     UserItemFeature,
     UserItemPrediction,
+    WideAndDeep,
+)
+from analytics_zoo_tpu.models.text import KNRM, TextClassifier  # noqa: F401
+from analytics_zoo_tpu.models.seq2seq import Seq2seq  # noqa: F401
+from analytics_zoo_tpu.models.anomaly import AnomalyDetector  # noqa: F401
+from analytics_zoo_tpu.models.image import (  # noqa: F401
+    ImageClassifier,
+    ResNet18,
+    ResNet50,
 )

@@ -118,3 +118,18 @@ class ZooModel:
 def register_model(cls: Type[ZooModel]) -> Type[ZooModel]:
     _MODEL_REGISTRY[cls.__name__] = cls
     return cls
+
+
+def softmax_probs(logits: np.ndarray) -> np.ndarray:
+    """Numerically-stable softmax over the last axis (host-side)."""
+    logits = np.asarray(logits, np.float32)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def topk_with_probs(probs: np.ndarray, k: int):
+    """Per-row top-k: [[(index, prob), ...], ...]."""
+    probs = np.asarray(probs)
+    top = np.argsort(-probs, axis=-1)[:, :k]
+    return [[(int(c), float(probs[i, c])) for c in row]
+            for i, row in enumerate(top)]

@@ -1,0 +1,12 @@
+"""Vision models (ref: zoo/.../models/image/{imageclassification,
+objectdetection})."""
+
+from analytics_zoo_tpu.models.image.resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet50,
+)
+from analytics_zoo_tpu.models.image.classifier import (  # noqa: F401
+    ImageClassifier,
+)
+from analytics_zoo_tpu.models.image import detection  # noqa: F401

@@ -1,0 +1,66 @@
+"""Image classification model wrapper.
+
+The analog of ``ImageClassifier`` (ref: zoo/.../models/image/
+imageclassification/ImageClassifier.scala -- load-and-predict of
+pretrained zoo models with an ``ImageConfigure`` preprocessing spec;
+here the backbone is trainable JAX ResNet, and predict applies the same
+normalize-resize preprocessing).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel, register_model
+from analytics_zoo_tpu.models.image.resnet import ResNet18, ResNet50
+
+_BACKBONES = {"resnet18": ResNet18, "resnet50": ResNet50}
+
+# ImageNet channel stats (the reference's ImageChannelNormalize defaults)
+_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+
+@register_model
+class ImageClassifier(ZooModel):
+    """Trainable classifier over a ResNet backbone."""
+
+    default_loss = "sparse_categorical_crossentropy"
+    default_optimizer = "adam"
+    default_metrics = ("accuracy", "top5")
+
+    def __init__(self, class_num: int, backbone: str = "resnet50",
+                 image_size: int = 224):
+        if backbone not in _BACKBONES:
+            raise ValueError(f"unknown backbone {backbone!r}; "
+                             f"known: {sorted(_BACKBONES)}")
+        super().__init__(class_num=class_num, backbone=backbone,
+                         image_size=image_size)
+
+    def _build_module(self):
+        c = self._config
+        return _BACKBONES[c["backbone"]](num_classes=c["class_num"])
+
+    def _example_input(self):
+        s = self._config["image_size"]
+        return np.zeros((1, s, s, 3), np.float32)
+
+    @staticmethod
+    def preprocess(images: np.ndarray) -> np.ndarray:
+        """uint8 [N, H, W, 3] -> normalized float32 (ref:
+        ImageChannelNormalize + MatToTensor chain)."""
+        x = np.asarray(images, np.float32) / 255.0
+        return (x - _MEAN) / _STD
+
+    def predict_classes(self, images, batch_size: int = 32,
+                        top_k: int = 1):
+        """Top-k (class, score) per image (ref: ImageClassifier
+        predictImageSet + topN postprocessing)."""
+        from analytics_zoo_tpu.models.common import (
+            softmax_probs, topk_with_probs)
+
+        logits = self.predict(self.preprocess(images),
+                              batch_size=batch_size)
+        return topk_with_probs(softmax_probs(logits), top_k)

@@ -6,3 +6,10 @@ from analytics_zoo_tpu.models.recommendation.base import (  # noqa: F401
     UserItemPrediction,
 )
 from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF  # noqa: F401
+from analytics_zoo_tpu.models.recommendation.wide_and_deep import (  # noqa: F401
+    ColumnFeatureInfo,
+    WideAndDeep,
+)
+from analytics_zoo_tpu.models.recommendation.session_recommender import (  # noqa: F401
+    SessionRecommender,
+)

@@ -85,10 +85,11 @@ class Recommender(ZooModel):
         return preds[:max_users]
 
     def _to_prediction(self, user, item, probs) -> UserItemPrediction:
+        from analytics_zoo_tpu.models.common import softmax_probs
+
         probs = np.asarray(probs).reshape(-1)
         if probs.shape[0] > 1:  # class logits -> softmax
-            e = np.exp(probs - probs.max())
-            sm = e / e.sum()
+            sm = softmax_probs(probs[None])[0]
             cls = int(np.argmax(sm))
             # class index c encodes label c+1 (ratings are 1-based,
             # ref: NeuralCFSpec label handling)

@@ -1,0 +1,128 @@
+"""Session-based recommender (GRU4Rec-style).
+
+The analog of ``SessionRecommender`` (ref: zoo/.../models/recommendation/
+SessionRecommender.scala, pyzoo session_recommender.py): item-embedding +
+GRU over the session sequence, optionally fused with an MLP over the
+user's longer purchase history, softmax over the item catalog.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common import register_model
+from analytics_zoo_tpu.models.recommendation.base import Recommender
+
+
+class SessionRecommenderNet(nn.Module):
+    item_count: int
+    item_embed: int
+    rnn_hidden_layers: Tuple[int, ...]
+    include_history: bool
+    mlp_hidden_layers: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self, x):
+        if isinstance(x, dict):
+            session, history = x["session"], x.get("history")
+        else:
+            session, history = x, None
+        emb = nn.Embed(self.item_count + 1, self.item_embed,
+                       name="item_embed")
+        h = emb(session.astype(jnp.int32))
+        for i, units in enumerate(self.rnn_hidden_layers):
+            h = nn.RNN(nn.GRUCell(units), name=f"gru_{i}")(h)
+        h = h[:, -1]
+        if self.include_history and history is not None:
+            hist = emb(history.astype(jnp.int32)).sum(axis=1)
+            for i, units in enumerate(self.mlp_hidden_layers):
+                hist = nn.relu(nn.Dense(units, name=f"mlp_{i}")(hist))
+            h = jnp.concatenate([h, hist], axis=-1)
+        return nn.Dense(self.item_count + 1, name="head")(h)
+
+
+@register_model
+class SessionRecommender(Recommender):
+    """(ref: SessionRecommender.scala). Item ids are 1-based; labels are
+    the next item id."""
+
+    default_loss = staticmethod(
+        lambda preds, labels: _next_item_ce(preds, labels))
+    default_optimizer = "adam"
+    default_metrics = ("top5",)
+
+    def __init__(self, item_count: int, item_embed: int = 100,
+                 rnn_hidden_layers: Sequence[int] = (40, 20),
+                 session_length: int = 10, include_history: bool = False,
+                 mlp_hidden_layers: Sequence[int] = (40, 20),
+                 history_length: int = 5):
+        self.item_count = item_count
+        super().__init__(
+            item_count=item_count, item_embed=item_embed,
+            rnn_hidden_layers=list(rnn_hidden_layers),
+            session_length=session_length,
+            include_history=include_history,
+            mlp_hidden_layers=list(mlp_hidden_layers),
+            history_length=history_length)
+
+    def _build_module(self):
+        c = self._config
+        return SessionRecommenderNet(
+            item_count=c["item_count"], item_embed=c["item_embed"],
+            rnn_hidden_layers=tuple(c["rnn_hidden_layers"]),
+            include_history=c["include_history"],
+            mlp_hidden_layers=tuple(c["mlp_hidden_layers"]))
+
+    def _example_input(self):
+        c = self._config
+        x = {"session": np.ones((1, c["session_length"]), np.int32)}
+        if c["include_history"]:
+            x["history"] = np.ones((1, c["history_length"]), np.int32)
+        return x
+
+    def recommend_for_session(self, sessions, max_items: int = 5,
+                              zero_based_label: bool = False,
+                              batch_size: int = 256):
+        """Top-K next items per session (ref: SessionRecommender.scala
+        recommendForSession). Returns [(item_id, prob), ...] per row;
+        ``zero_based_label`` shifts reported ids to a 0-based catalog."""
+        from analytics_zoo_tpu.models.common import (
+            softmax_probs, topk_with_probs)
+
+        logits = self.predict(sessions, batch_size=batch_size)
+        probs = softmax_probs(logits)
+        probs[:, 0] = 0.0  # id 0 is padding, never recommend
+        top = topk_with_probs(probs, max_items)
+        if zero_based_label:
+            top = [[(i - 1, p) for i, p in row] for row in top]
+        return top
+
+    # the session API replaces pair scoring; inherited Recommender pair
+    # methods would silently embed user ids as items
+    def predict_user_item_pair(self, pairs, batch_size: int = 1024):
+        raise NotImplementedError(
+            "SessionRecommender recommends from item sessions; use "
+            "recommend_for_session (ref: SessionRecommender.scala)")
+
+    def recommend_for_user(self, *a, **k):
+        raise NotImplementedError(
+            "SessionRecommender has no user ids; use "
+            "recommend_for_session")
+
+    def recommend_for_item(self, *a, **k):
+        raise NotImplementedError(
+            "SessionRecommender has no user ids; use "
+            "recommend_for_session")
+
+
+def _next_item_ce(preds, labels):
+    from analytics_zoo_tpu.learn.objectives import (
+        sparse_categorical_crossentropy)
+
+    return sparse_categorical_crossentropy(
+        preds, jnp.asarray(labels).reshape(-1).astype(jnp.int32))

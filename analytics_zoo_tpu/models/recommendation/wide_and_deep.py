@@ -1,0 +1,177 @@
+"""Wide & Deep recommender.
+
+The analog of ``WideAndDeep`` (ref: zoo/.../models/recommendation/
+WideAndDeep.scala:101, pyzoo/zoo/models/recommendation/wide_and_deep.py):
+a linear "wide" path over sparse crossed features + a "deep" MLP over
+embeddings/indicators/continuous features. North-star workload #2
+(BASELINE.md: wide_n_deep.ipynb).
+
+Feature dict convention (replacing the reference's SparseTensor rows):
+- ``wide``      int32 [B, n_wide]   -- active indices into the summed
+                                        wide dimension (pad with 0)
+- ``embed``     int32 [B, n_embed]  -- one id per embedding column
+- ``indicator`` float32 [B, sum(indicator_dims)] -- multi-hot block
+- ``continuous`` float32 [B, n_cont]
+Missing keys are allowed if the corresponding columns are empty.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common import register_model
+from analytics_zoo_tpu.models.recommendation.base import Recommender
+from analytics_zoo_tpu.models.recommendation.ncf import (
+    _RatingAccuracy, _shifted_ce)
+
+
+@dataclass
+class ColumnFeatureInfo:
+    """(ref: recommendation/WideAndDeep.scala ColumnFeatureInfo)."""
+
+    wide_base_cols: List[str] = field(default_factory=list)
+    wide_base_dims: List[int] = field(default_factory=list)
+    wide_cross_cols: List[str] = field(default_factory=list)
+    wide_cross_dims: List[int] = field(default_factory=list)
+    indicator_cols: List[str] = field(default_factory=list)
+    indicator_dims: List[int] = field(default_factory=list)
+    embed_cols: List[str] = field(default_factory=list)
+    embed_in_dims: List[int] = field(default_factory=list)
+    embed_out_dims: List[int] = field(default_factory=list)
+    continuous_cols: List[str] = field(default_factory=list)
+
+    @property
+    def wide_dim(self) -> int:
+        return sum(self.wide_base_dims) + sum(self.wide_cross_dims)
+
+    @property
+    def indicator_dim(self) -> int:
+        return sum(self.indicator_dims)
+
+
+class WideAndDeepNet(nn.Module):
+    model_type: str
+    class_num: int
+    wide_dim: int
+    embed_in_dims: Tuple[int, ...]
+    embed_out_dims: Tuple[int, ...]
+    indicator_dim: int
+    n_continuous: int
+    hidden_layers: Tuple[int, ...] = (40, 20, 10)
+
+    @nn.compact
+    def __call__(self, x):
+        logits = None
+        if self.model_type in ("wide_n_deep", "wide"):
+            # linear over sparse active indices == embedding-sum with a
+            # [wide_dim, class_num] weight table (one extra pad row 0)
+            wide_idx = x["wide"].astype(jnp.int32)
+            table = self.param(
+                "wide_weight", nn.initializers.zeros,
+                (self.wide_dim + 1, self.class_num))
+            gathered = jnp.take(table, wide_idx, axis=0)
+            # zero out pad slots (index 0) so predictions are independent
+            # of how many pads a row carries
+            gathered = gathered * (wide_idx > 0)[..., None]
+            logits = jnp.sum(gathered, axis=1)
+            logits = logits + self.param(
+                "wide_bias", nn.initializers.zeros, (self.class_num,))
+        if self.model_type in ("wide_n_deep", "deep"):
+            parts = []
+            if self.embed_in_dims:
+                ids = x["embed"].astype(jnp.int32)
+                for i, (din, dout) in enumerate(
+                        zip(self.embed_in_dims, self.embed_out_dims)):
+                    parts.append(nn.Embed(din + 1, dout,
+                                          name=f"embed_{i}")(ids[:, i]))
+            if self.indicator_dim:
+                parts.append(x["indicator"].astype(jnp.float32))
+            if self.n_continuous:
+                parts.append(x["continuous"].astype(jnp.float32))
+            if not parts:
+                raise ValueError("deep path has no input columns")
+            h = jnp.concatenate(parts, axis=-1)
+            for k, units in enumerate(self.hidden_layers):
+                h = nn.relu(nn.Dense(units, name=f"dense_{k}")(h))
+            deep_logits = nn.Dense(self.class_num, name="deep_head")(h)
+            logits = (deep_logits if logits is None
+                      else logits + deep_logits)
+        return logits
+
+
+@register_model
+class WideAndDeep(Recommender):
+    """(ref: WideAndDeep.scala:101). Labels are 1-based ratings."""
+
+    default_loss = staticmethod(_shifted_ce)
+    default_optimizer = "adam"
+
+    @property
+    def default_metrics(self):
+        return (_RatingAccuracy(),)
+
+    def __init__(self, model_type: str = "wide_n_deep", class_num: int = 2,
+                 column_info: ColumnFeatureInfo = None,
+                 hidden_layers: Sequence[int] = (40, 20, 10), **ci_kwargs):
+        if model_type not in ("wide_n_deep", "wide", "deep"):
+            raise ValueError(f"unknown model_type {model_type!r}")
+        info = column_info or ColumnFeatureInfo(**ci_kwargs)
+        if isinstance(info, dict):
+            info = ColumnFeatureInfo(**info)
+        self.column_info = info
+        super().__init__(
+            model_type=model_type, class_num=class_num,
+            column_info=info.__dict__, hidden_layers=list(hidden_layers))
+
+    def _build_module(self):
+        c = self._config
+        info = ColumnFeatureInfo(**c["column_info"])
+        return WideAndDeepNet(
+            model_type=c["model_type"], class_num=c["class_num"],
+            wide_dim=info.wide_dim,
+            embed_in_dims=tuple(info.embed_in_dims),
+            embed_out_dims=tuple(info.embed_out_dims),
+            indicator_dim=info.indicator_dim,
+            n_continuous=len(info.continuous_cols),
+            hidden_layers=tuple(c["hidden_layers"]))
+
+    # pair-based Recommender methods need a user/item -> feature-dict
+    # builder (the reference assembles features from DataFrame rows,
+    # ref: WideAndDeep.scala recommendForUser via assemblyFeature);
+    # without one, scoring raw id pairs would be silent garbage
+    def predict_user_item_pair(self, pairs, batch_size: int = 1024):
+        raise NotImplementedError(
+            "WideAndDeep scores feature dicts (wide/embed/indicator/"
+            "continuous); build features per (user, item) and call "
+            "predict directly")
+
+    def recommend_for_user(self, *a, **k):
+        raise NotImplementedError(
+            "WideAndDeep needs assembled features; build candidate "
+            "feature dicts and call predict")
+
+    def recommend_for_item(self, *a, **k):
+        raise NotImplementedError(
+            "WideAndDeep needs assembled features; build candidate "
+            "feature dicts and call predict")
+
+    def _example_input(self):
+        info = self.column_info
+        x = {}
+        if self._config["model_type"] in ("wide_n_deep", "wide"):
+            x["wide"] = np.zeros(
+                (1, max(len(info.wide_base_cols)
+                        + len(info.wide_cross_cols), 1)), np.int32)
+        if info.embed_cols:
+            x["embed"] = np.zeros((1, len(info.embed_cols)), np.int32)
+        if info.indicator_dim:
+            x["indicator"] = np.zeros((1, info.indicator_dim), np.float32)
+        if info.continuous_cols:
+            x["continuous"] = np.zeros(
+                (1, len(info.continuous_cols)), np.float32)
+        return x

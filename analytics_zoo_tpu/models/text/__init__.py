@@ -1,0 +1,6 @@
+"""Text models (ref: zoo/.../models/{textclassification,textmatching})."""
+
+from analytics_zoo_tpu.models.text.classifier import (  # noqa: F401
+    TextClassifier,
+)
+from analytics_zoo_tpu.models.text.knrm import KNRM  # noqa: F401

@@ -1,0 +1,162 @@
+"""KNRM: kernel-pooling neural ranking model.
+
+The analog of ``KNRM`` (ref: zoo/.../models/textmatching/KNRM.scala,
+pyzoo/zoo/models/textmatching/knrm.py; Xiong et al. 2017): query/doc token
+ids -> shared embedding -> cosine translation matrix -> RBF kernel pooling
+-> dense score. Used with rank_hinge loss on (pos, neg) pair batches for
+ranking, or sigmoid BCE for classification.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel, register_model
+
+
+class KNRMNet(nn.Module):
+    text1_length: int
+    text2_length: int
+    vocab: int
+    embed_dim: int
+    kernel_num: int = 21
+    sigma: float = 0.1
+    exact_sigma: float = 0.001
+    target_mode: str = "ranking"
+
+    @nn.compact
+    def __call__(self, x):
+        # x: int32 [B, text1_length + text2_length] (query ++ doc,
+        # matching the reference's concatenated input, KNRM.scala input),
+        # or [B, 2, L1+L2] (pos, neg) pairs for ranking training -- pairs
+        # must live inside one sample so epoch shuffling cannot split them
+        ids = x.astype(jnp.int32)
+        paired = ids.ndim == 3
+        if paired:
+            b, two, ll = ids.shape
+            ids = ids.reshape(b * two, ll)
+        q_ids = ids[:, :self.text1_length]
+        d_ids = ids[:, self.text1_length:]
+        emb = nn.Embed(self.vocab + 1, self.embed_dim, name="embedding")
+        q = emb(q_ids)
+        d = emb(d_ids)
+        qn = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True),
+                             1e-8)
+        dn = d / jnp.maximum(jnp.linalg.norm(d, axis=-1, keepdims=True),
+                             1e-8)
+        # translation matrix [B, Lq, Ld]
+        sim = jnp.einsum("bqe,bde->bqd", qn, dn)
+        # RBF kernels: mus spread over [-1, 1], last kernel exact-match
+        ks = self.kernel_num
+        mus = jnp.asarray(
+            [1.0 if i == ks - 1 else -1.0 + (2 * i + 1) / (ks - 1)
+             for i in range(ks)], jnp.float32)
+        sigmas = jnp.asarray(
+            [self.exact_sigma if i == ks - 1 else self.sigma
+             for i in range(ks)], jnp.float32)
+        # [B, Lq, Ld, K]
+        k = jnp.exp(-jnp.square(sim[..., None] - mus) /
+                    (2 * jnp.square(sigmas)))
+        # mask padding tokens (id 0)
+        qmask = (q_ids > 0).astype(jnp.float32)[:, :, None, None]
+        dmask = (d_ids > 0).astype(jnp.float32)[:, None, :, None]
+        k = k * qmask * dmask
+        # soft-TF: sum over doc, log, sum over query
+        soft_tf = jnp.sum(k, axis=2)                       # [B, Lq, K]
+        log_k = jnp.log(jnp.clip(soft_tf, 1e-10)) * 0.01
+        log_k = log_k * qmask[:, :, 0]
+        phi = jnp.sum(log_k, axis=1)                       # [B, K]
+        score = nn.Dense(1, name="head")(phi)
+        if self.target_mode == "classification":
+            return jnp.concatenate([jnp.zeros_like(score), score], -1)
+        if paired:
+            return score.reshape(b, two)  # rank_hinge sees (pos, neg)
+        return score
+
+
+@register_model
+class KNRM(ZooModel):
+    """(ref: KNRM.scala). ``target_mode``: "ranking" (score head, use
+    rank_hinge on pos/neg pairs) or "classification" (2-class logits)."""
+
+    default_loss = "rank_hinge"
+    default_optimizer = "adam"
+
+    def __init__(self, text1_length: int, text2_length: int,
+                 vocab: int = 20000, embed_dim: int = 50,
+                 kernel_num: int = 21, sigma: float = 0.1,
+                 exact_sigma: float = 0.001,
+                 target_mode: str = "ranking"):
+        if target_mode not in ("ranking", "classification"):
+            raise ValueError(f"bad target_mode {target_mode!r}")
+        if target_mode == "classification":
+            self.default_loss = "sparse_categorical_crossentropy"
+        super().__init__(text1_length=text1_length,
+                         text2_length=text2_length, vocab=vocab,
+                         embed_dim=embed_dim, kernel_num=kernel_num,
+                         sigma=sigma, exact_sigma=exact_sigma,
+                         target_mode=target_mode)
+
+    def _build_module(self):
+        c = self._config
+        return KNRMNet(
+            text1_length=c["text1_length"], text2_length=c["text2_length"],
+            vocab=c["vocab"], embed_dim=c["embed_dim"],
+            kernel_num=c["kernel_num"], sigma=c["sigma"],
+            exact_sigma=c["exact_sigma"], target_mode=c["target_mode"])
+
+    def _example_input(self):
+        c = self._config
+        return np.ones((1, c["text1_length"] + c["text2_length"]),
+                       np.int32)
+
+    # ------------------------------------------------- ranking metrics --
+    def evaluate_ndcg(self, query_doc_ids, labels, k: int = 5,
+                      batch_size: int = 256) -> float:
+        """NDCG@k over grouped (query, [docs]) relations
+        (ref: common/Ranker.scala evaluateNDCG). ``query_doc_ids`` is
+        [N, L1+L2] with one row per (q, d) pair; ``labels`` is a list of
+        per-query relevance lists aligned with contiguous row groups."""
+        scores = np.asarray(self.predict(query_doc_ids,
+                                         batch_size=batch_size)).reshape(-1)
+        return float(np.mean([_ndcg(scores[lo:hi], rel, k)
+                              for lo, hi, rel in _groups(labels)]))
+
+    def evaluate_map(self, query_doc_ids, labels,
+                     batch_size: int = 256) -> float:
+        """(ref: common/Ranker.scala evaluateMAP)."""
+        scores = np.asarray(self.predict(query_doc_ids,
+                                         batch_size=batch_size)).reshape(-1)
+        return float(np.mean([_ap(scores[lo:hi], rel)
+                              for lo, hi, rel in _groups(labels)]))
+
+
+def _groups(labels):
+    lo = 0
+    for rel in labels:
+        hi = lo + len(rel)
+        yield lo, hi, np.asarray(rel, np.float32)
+        lo = hi
+
+
+def _ndcg(scores, rel, k):
+    order = np.argsort(-scores)[:k]
+    gains = (2 ** rel[order] - 1) / np.log2(np.arange(2, len(order) + 2))
+    ideal_order = np.argsort(-rel)[:k]
+    ideal = (2 ** rel[ideal_order] - 1) / np.log2(
+        np.arange(2, len(ideal_order) + 2))
+    denom = ideal.sum()
+    return gains.sum() / denom if denom > 0 else 0.0
+
+
+def _ap(scores, rel):
+    order = np.argsort(-scores)
+    rel_sorted = rel[order] > 0
+    if not rel_sorted.any():
+        return 0.0
+    precision = np.cumsum(rel_sorted) / np.arange(1, len(rel_sorted) + 1)
+    return float((precision * rel_sorted).sum() / rel_sorted.sum())

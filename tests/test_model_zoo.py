@@ -1,0 +1,214 @@
+"""Model-zoo tests: WideAndDeep, SessionRecommender, TextClassifier,
+KNRM, Seq2seq, AnomalyDetector, ImageClassifier, detection utils."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.learn import Adam
+from analytics_zoo_tpu.models import (
+    AnomalyDetector, ColumnFeatureInfo, ImageClassifier, KNRM,
+    Seq2seq, SessionRecommender, TextClassifier, WideAndDeep, ZooModel,
+)
+from analytics_zoo_tpu.models.image.detection import (
+    bbox_iou, clip_boxes, decode_boxes, detect_per_class, nms,
+)
+
+
+class TestWideAndDeep:
+    def make_data(self, n=256, seed=0):
+        rng = np.random.RandomState(seed)
+        wide = rng.randint(1, 20, (n, 2)).astype(np.int32)
+        embed = rng.randint(0, 10, (n, 2)).astype(np.int32)
+        cont = rng.randn(n, 3).astype(np.float32)
+        y = ((wide[:, 0] > 10).astype(int) + (cont[:, 0] > 0) + 1
+             ).astype(np.int32)  # ratings in 1..3
+        x = {"wide": wide, "embed": embed, "continuous": cont}
+        return x, y
+
+    def info(self):
+        return ColumnFeatureInfo(
+            wide_base_cols=["a", "b"], wide_base_dims=[10, 10],
+            embed_cols=["u", "i"], embed_in_dims=[10, 10],
+            embed_out_dims=[8, 8], continuous_cols=["c1", "c2", "c3"])
+
+    @pytest.mark.parametrize("model_type", ["wide_n_deep", "wide", "deep"])
+    def test_all_model_types_train(self, model_type):
+        x, y = self.make_data()
+        m = WideAndDeep(model_type, class_num=3, column_info=self.info())
+        m.compile(optimizer=Adam(1e-2))
+        hist = m.fit((x, y), batch_size=64, epochs=5)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_save_load(self, tmp_path):
+        x, y = self.make_data()
+        m = WideAndDeep("wide_n_deep", class_num=3,
+                        column_info=self.info())
+        m.fit((x, y), batch_size=64, epochs=1)
+        before = m.predict(x, batch_size=64)
+        m.save_model(str(tmp_path / "wnd"))
+        loaded = ZooModel.load_model(str(tmp_path / "wnd"))
+        np.testing.assert_allclose(before,
+                                   loaded.predict(x, batch_size=64),
+                                   atol=1e-5)
+
+
+class TestSessionRecommender:
+    def test_train_and_recommend(self):
+        rng = np.random.RandomState(0)
+        n, items, sess_len = 256, 30, 5
+        sessions = rng.randint(1, items + 1, (n, sess_len)).astype(np.int32)
+        nxt = ((sessions[:, -1] % items) + 1).astype(np.int32)
+        m = SessionRecommender(items, item_embed=16,
+                               rnn_hidden_layers=[16],
+                               session_length=sess_len)
+        m.compile(optimizer=Adam(1e-2))
+        hist = m.fit(({"session": sessions}, nxt), batch_size=64,
+                     epochs=10)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        recs = m.recommend_for_session({"session": sessions[:8]},
+                                       max_items=3)
+        assert len(recs) == 8 and len(recs[0]) == 3
+        assert all(p >= recs[0][-1][1] for _, p in recs[0])
+
+    def test_history_variant(self):
+        rng = np.random.RandomState(1)
+        sessions = rng.randint(1, 21, (64, 4)).astype(np.int32)
+        history = rng.randint(1, 21, (64, 6)).astype(np.int32)
+        nxt = ((sessions[:, -1] % 20) + 1).astype(np.int32)
+        m = SessionRecommender(20, item_embed=8, rnn_hidden_layers=[8],
+                               session_length=4, include_history=True,
+                               mlp_hidden_layers=[8], history_length=6)
+        hist = m.fit(({"session": sessions, "history": history}, nxt),
+                     batch_size=32, epochs=2)
+        assert np.isfinite(hist[-1]["loss"])
+
+
+class TestTextClassifier:
+    @pytest.mark.parametrize("encoder", ["cnn", "lstm", "gru"])
+    def test_encoders_train(self, encoder):
+        rng = np.random.RandomState(0)
+        ids = rng.randint(1, 50, (128, 16)).astype(np.int32)
+        y = (ids[:, 0] > 25).astype(np.int32)
+        m = TextClassifier(class_num=2, vocab=50, embed_dim=16,
+                           sequence_length=16, encoder=encoder,
+                           encoder_output_dim=16)
+        m.compile(optimizer=Adam(1e-2))
+        hist = m.fit((ids, y), batch_size=32, epochs=4)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+class TestKNRM:
+    def test_ranking_trains_and_metrics(self):
+        rng = np.random.RandomState(0)
+        l1, l2, n_pairs = 4, 8, 64
+        # pairs: (pos, neg) interleaved; pos docs share tokens with query
+        pairs = []
+        for _ in range(n_pairs):
+            q = rng.randint(1, 30, l1)
+            pos = np.concatenate([q, rng.randint(1, 30, l2 - l1)])
+            neg = rng.randint(30, 60, l2)
+            pairs.append([np.concatenate([q, pos]),
+                          np.concatenate([q, neg])])
+        x = np.asarray(pairs, np.int32)          # [N, 2, L1+L2]
+        y = np.zeros((len(pairs),), np.float32)  # unused by rank_hinge
+        m = KNRM(l1, l2, vocab=60, embed_dim=12)
+        m.compile(optimizer=Adam(1e-2))
+        hist = m.fit((x, y), batch_size=16, epochs=8)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        # grouped ranking metrics over flattened (pos, neg) rows
+        flat = x[:8].reshape(16, -1)
+        labels = [[1, 0]] * 8  # 8 queries, (pos, neg) per query
+        ndcg = m.evaluate_ndcg(flat, labels, k=2)
+        mp = m.evaluate_map(flat, labels)
+        assert 0.0 <= ndcg <= 1.0 and 0.0 <= mp <= 1.0
+        assert mp > 0.6  # trained model ranks pos above neg mostly
+
+
+class TestSeq2seq:
+    def test_copy_task(self):
+        rng = np.random.RandomState(0)
+        n, L, vocab = 256, 6, 12
+        src = rng.randint(2, vocab, (n, L)).astype(np.int32)
+        # task: echo the source; tgt_in = [BOS, y0..y_{L-2}], BOS=1
+        tgt_out = src
+        tgt_in = np.concatenate(
+            [np.ones((n, 1), np.int32), src[:, :-1]], axis=1)
+        m = Seq2seq(vocab=vocab, embed_dim=24, hidden_sizes=[48],
+                    bridge="dense", max_len=L)
+        m.compile(optimizer=Adam(5e-3))
+        hist = m.fit(({"src": src, "tgt_in": tgt_in}, tgt_out),
+                     batch_size=64, epochs=30)
+        assert hist[-1]["loss"] < hist[0]["loss"] * 0.5
+        gen = m.infer(src[:4], start_id=1, max_len=L)
+        assert gen.shape == (4, L)
+
+    def test_save_load(self, tmp_path):
+        m = Seq2seq(vocab=10, embed_dim=8, hidden_sizes=[8])
+        src = np.ones((8, 4), np.int32)
+        tgt_in = np.ones((8, 4), np.int32)
+        m.fit(({"src": src, "tgt_in": tgt_in}, src), batch_size=8,
+              epochs=1)
+        m.save_model(str(tmp_path / "s2s"))
+        loaded = ZooModel.load_model(str(tmp_path / "s2s"))
+        assert isinstance(loaded, Seq2seq)
+
+
+class TestAnomalyDetector:
+    def test_unroll_train_detect(self):
+        t = np.arange(300, dtype=np.float32)
+        series = np.sin(t * 0.1)
+        series[250] += 5.0  # planted anomaly
+        x, y = AnomalyDetector.unroll(series, 10)
+        m = AnomalyDetector(feature_shape=(10, 1), hidden_layers=[8],
+                            dropouts=[0.0])
+        m.compile(optimizer=Adam(1e-2))
+        hist = m.fit((x, y), batch_size=32, epochs=10)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        preds = m.predict(x, batch_size=32).reshape(-1)
+        idx, thr = AnomalyDetector.detect_anomalies(y, preds, 3)
+        assert (250 - 10) in idx  # the planted spike is flagged
+
+
+class TestImage:
+    def test_resnet18_trains(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 32, 32, 3).astype(np.float32)
+        y = (x.mean((1, 2, 3)) > 0).astype(np.int32)
+        m = ImageClassifier(class_num=2, backbone="resnet18",
+                            image_size=32)
+        m.compile(optimizer=Adam(1e-3))
+        hist = m.fit((x, y), batch_size=16, epochs=2)
+        assert np.isfinite(hist[-1]["loss"])
+        top = m.predict_classes((x[:4] * 50 + 128).clip(0, 255)
+                                .astype(np.uint8), top_k=2)
+        assert len(top) == 4 and len(top[0]) == 2
+
+    def test_bbox_utils(self):
+        a = np.asarray([[0, 0, 10, 10]], np.float32)
+        b = np.asarray([[0, 0, 10, 10], [5, 5, 15, 15],
+                        [20, 20, 30, 30]], np.float32)
+        iou = bbox_iou(a, b)[0]
+        np.testing.assert_allclose(iou, [1.0, 25 / 175, 0.0], atol=1e-5)
+
+        boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                            [20, 20, 30, 30]], np.float32)
+        scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+        keep = nms(boxes, scores, iou_threshold=0.5)
+        assert list(keep) == [0, 2]  # near-duplicate suppressed
+
+        anchors = np.asarray([[0, 0, 10, 10]], np.float32)
+        decoded = decode_boxes(anchors, np.zeros((1, 4), np.float32))
+        np.testing.assert_allclose(decoded, anchors, atol=1e-5)
+
+        clipped = clip_boxes(np.asarray([[-5, -5, 50, 50]], np.float32),
+                             20, 30)
+        np.testing.assert_allclose(clipped, [[0, 0, 30, 20]])
+
+    def test_detect_per_class(self):
+        boxes = np.asarray([[0, 0, 10, 10], [0, 0, 10, 10],
+                            [20, 20, 30, 30]], np.float32)
+        scores = np.asarray([[0.1, 0.9, 0.0], [0.2, 0.7, 0.1],
+                             [0.1, 0.0, 0.8]], np.float32)
+        dets = detect_per_class(boxes, scores, score_threshold=0.3)
+        assert len(dets) == 2  # duplicate box suppressed
+        assert dets[0][0] == 1 and dets[1][0] == 2
