@@ -1,0 +1,24 @@
+"""Multi-format inference runtime.
+
+The analog of ``InferenceModel`` (ref: zoo/.../pipeline/inference/
+InferenceModel.scala:28-608 and the Java AbstractInferenceModel) --
+re-designed TPU-first: where the reference keeps a blocking queue of
+``concurrentNum`` model copies for thread-safe prediction, XLA executables
+are thread-safe, so one AOT-compiled executable per batch-shape bucket
+serves all threads (SURVEY.md section 7 step 7).
+"""
+
+from analytics_zoo_tpu.inference.inference_model import (  # noqa: F401
+    InferenceModel,
+)
+from analytics_zoo_tpu.inference.quantize import (  # noqa: F401
+    dequantize_params,
+    quantize_params,
+)
+from analytics_zoo_tpu.inference.encrypt import (  # noqa: F401
+    decrypt_bytes,
+    encrypt_bytes,
+)
+from analytics_zoo_tpu.inference.importers import (  # noqa: F401
+    import_torch_state_dict,
+)

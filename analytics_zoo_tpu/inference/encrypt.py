@@ -1,0 +1,44 @@
+"""Encrypted model storage.
+
+The analog of ``EncryptSupportive`` (ref: zoo/.../pipeline/inference/
+EncryptSupportive.scala:26-77 -- AES/CBC/PKCS5Padding with a
+PBKDF2-derived key): AES-256-CBC + PKCS7, PBKDF2-HMAC-SHA256 key
+derivation, random IV + salt prepended to the ciphertext.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives import hashes, padding
+from cryptography.hazmat.primitives.ciphers import (
+    Cipher, algorithms, modes)
+from cryptography.hazmat.primitives.kdf.pbkdf2 import PBKDF2HMAC
+
+_ITERATIONS = 65536  # ref: EncryptSupportive.scala iteration count
+_KEY_LEN = 32
+
+
+def _derive(secret: str, salt: bytes) -> bytes:
+    kdf = PBKDF2HMAC(algorithm=hashes.SHA256(), length=_KEY_LEN,
+                     salt=salt, iterations=_ITERATIONS)
+    return kdf.derive(secret.encode("utf-8"))
+
+
+def encrypt_bytes(data: bytes, secret: str) -> bytes:
+    salt = os.urandom(16)
+    iv = os.urandom(16)
+    key = _derive(secret, salt)
+    padder = padding.PKCS7(128).padder()
+    padded = padder.update(data) + padder.finalize()
+    enc = Cipher(algorithms.AES(key), modes.CBC(iv)).encryptor()
+    return salt + iv + enc.update(padded) + enc.finalize()
+
+
+def decrypt_bytes(blob: bytes, secret: str) -> bytes:
+    salt, iv, ct = blob[:16], blob[16:32], blob[32:]
+    key = _derive(secret, salt)
+    dec = Cipher(algorithms.AES(key), modes.CBC(iv)).decryptor()
+    padded = dec.update(ct) + dec.finalize()
+    unpadder = padding.PKCS7(128).unpadder()
+    return unpadder.update(padded) + unpadder.finalize()

@@ -1,0 +1,55 @@
+"""Foreign-framework weight importers.
+
+The analog of the reference's interop loaders (TFNet frozen graphs,
+TorchNet/TorchModel, ONNX -- ref: zoo/.../pipeline/api/net/,
+pyzoo/zoo/pipeline/api/onnx). The TPU stack is single-framework, so
+interop is *weight import*, not execution bridging (SURVEY.md section
+2.4: "keep a torch->JAX weight importer").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+def import_torch_state_dict(state_dict, key_map: Optional[Dict[str, str]]
+                            = None,
+                            transpose_linear: bool = True) -> Dict:
+    """torch ``state_dict`` (or path to a ``torch.save`` file) -> nested
+    flax-style params dict.
+
+    - dots become nesting: ``enc.fc.weight`` -> params[enc][fc][...]
+    - ``weight``/``bias`` become flax's ``kernel``/``bias``; 2-D linear
+      weights are transposed ([out, in] -> [in, out]);
+    - 4-D conv weights go OIHW -> HWIO (channels-last);
+    - ``key_map`` renames torch prefixes to flax module paths first.
+    """
+    if isinstance(state_dict, str):
+        import torch
+
+        state_dict = torch.load(state_dict, map_location="cpu",
+                                weights_only=True)
+    out: Dict = {}
+    for key, value in state_dict.items():
+        arr = np.asarray(value.detach().cpu().numpy()
+                         if hasattr(value, "detach") else value)
+        if key_map:
+            for src, dst in key_map.items():
+                if key.startswith(src):
+                    key = dst + key[len(src):]
+                    break
+        parts = key.split(".")
+        leaf = parts[-1]
+        if leaf == "weight":
+            if arr.ndim == 2 and transpose_linear:
+                arr = arr.T
+            elif arr.ndim == 4:
+                arr = arr.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+            leaf = "kernel" if arr.ndim >= 2 else "scale"
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[leaf] = arr
+    return out

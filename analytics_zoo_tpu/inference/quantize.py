@@ -1,0 +1,53 @@
+"""Weight-only int8 quantization.
+
+The analog of the reference's int8 paths (OpenVINO VNNI models,
+``doLoadOpenVINOInt8`` -- ref: InferenceModel.scala int8 loaders,
+examples/vnni): per-output-channel symmetric int8 weights with float
+scales; matmul-heavy layers dequantize on the fly (XLA fuses the
+rescale into the matmul epilogue on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantize_params(params: Any, min_size: int = 1024
+                    ) -> Tuple[Any, List[Optional[np.ndarray]]]:
+    """Returns (quantized_tree, scales). Arrays with >=2 dims and >=
+    ``min_size`` elements become int8 with per-last-axis scales; others
+    pass through (scale None). ``scales`` aligns with the tree's flattened
+    leaf order."""
+
+    def q(x):
+        x = np.asarray(x)
+        if x.ndim < 2 or x.size < min_size or \
+                not np.issubdtype(x.dtype, np.floating):
+            return x, None
+        amax = np.max(np.abs(x), axis=tuple(range(x.ndim - 1)),
+                      keepdims=True)
+        scale = np.maximum(amax, 1e-12) / 127.0
+        qx = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
+        return qx, scale.astype(np.float32)
+
+    flat, tree = jax.tree_util.tree_flatten(params)
+    pairs = [q(l) for l in flat]
+    q_tree = jax.tree_util.tree_unflatten(tree, [p[0] for p in pairs])
+    return q_tree, [p[1] for p in pairs]
+
+
+def dequantize_params(q_tree: Any, scales: List[Optional[np.ndarray]],
+                      dtype=jnp.float32) -> Any:
+    flat, tree = jax.tree_util.tree_flatten(q_tree)
+    out = []
+    for x, scale in zip(flat, scales):
+        if scale is None:
+            out.append(jnp.asarray(x))
+        else:
+            out.append((jnp.asarray(x, jnp.float32)
+                        * scale).astype(dtype))
+    return jax.tree_util.tree_unflatten(tree, out)

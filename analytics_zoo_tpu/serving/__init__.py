@@ -1,0 +1,23 @@
+"""Streaming model serving.
+
+The analog of Cluster Serving (ref: zoo/.../serving -- Flink job reading
+Redis Streams, micro-batching into an InferenceModel, akka-http frontend;
+SURVEY.md sections 2.1/3.5). The TPU-native redesign replaces
+Flink TM + Redis + akka with: a dependency-free durable queue (directory
+backend, atomic claim via rename; or in-memory for single-process),
+a micro-batcher with bounded backpressure, a serving worker around
+``InferenceModel``, and a stdlib HTTP frontend with /predict + /metrics.
+"""
+
+from analytics_zoo_tpu.serving.queues import (  # noqa: F401
+    InputQueue,
+    OutputQueue,
+    DirQueue,
+    MemQueue,
+)
+from analytics_zoo_tpu.serving.batcher import MicroBatcher  # noqa: F401
+from analytics_zoo_tpu.serving.worker import ServingWorker  # noqa: F401
+from analytics_zoo_tpu.serving.timer import Timer  # noqa: F401
+from analytics_zoo_tpu.serving.http_frontend import (  # noqa: F401
+    HttpFrontend,
+)

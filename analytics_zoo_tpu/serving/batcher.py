@@ -1,0 +1,41 @@
+"""Micro-batcher with bounded backpressure.
+
+The analog of ``ClusterServingInference`` batching
+(ref: zoo/.../serving/engine/ClusterServingInference.scala:33-160 --
+groups up to ``batchSize`` requests per inference call; Flink supplied
+backpressure upstream, here the bounded input queue does, SURVEY.md
+section 7 "hard parts: serving ... our batcher must implement it").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class MicroBatcher:
+    """Pulls items from a queue-like (``get(timeout)``), groups up to
+    ``batch_size`` within ``timeout_ms`` of the first item."""
+
+    def __init__(self, queue, batch_size: int = 8,
+                 timeout_ms: float = 5.0):
+        self.queue = queue
+        self.batch_size = batch_size
+        self.timeout_ms = timeout_ms
+
+    def next_batch(self, wait_timeout: Optional[float] = 1.0
+                   ) -> List[Any]:
+        first = self.queue.get(timeout=wait_timeout)
+        if first is None:
+            return []
+        batch = [first]
+        deadline = time.time() + self.timeout_ms / 1000.0
+        while len(batch) < self.batch_size:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                break
+            item = self.queue.get(timeout=remaining)
+            if item is None:
+                break
+            batch.append(item)
+        return batch

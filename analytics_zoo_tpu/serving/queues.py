@@ -1,0 +1,162 @@
+"""Serving queues: the Redis-Streams role, dependency-free.
+
+The client API mirrors the reference's ``InputQueue``/``OutputQueue``
+(ref: pyzoo/zoo/serving/client.py:52-250 -- enqueue XADDs base64-encoded
+tensors; dequeue reads the result stream). Backends:
+
+- ``MemQueue``: in-process deque (single-process serving, tests);
+- ``DirQueue``: a spool directory; each item is one ``.npz`` file,
+  consumers claim atomically with ``os.rename`` -- cross-process safe
+  with no broker, and items survive crashes (the durability Redis
+  provided in the reference).
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _encode(uri: str, payload: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, __uri__=np.asarray(uri),
+             **{k: np.asarray(v) for k, v in payload.items()})
+    return buf.getvalue()
+
+
+def _decode(blob: bytes) -> Tuple[str, Dict[str, np.ndarray]]:
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        uri = str(z["__uri__"])
+        return uri, {k: z[k] for k in z.files if k != "__uri__"}
+
+
+class MemQueue:
+    def __init__(self, maxlen: Optional[int] = None):
+        self._q: collections.deque = collections.deque()
+        self._maxlen = maxlen
+        self._cv = threading.Condition()
+
+    def put(self, item: bytes) -> bool:
+        with self._cv:
+            if self._maxlen is not None and len(self._q) >= self._maxlen:
+                return False  # backpressure signal
+            self._q.append(item)
+            self._cv.notify()
+            return True
+
+    def get(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        with self._cv:
+            if not self._q:
+                self._cv.wait(timeout)
+            if not self._q:
+                return None
+            return self._q.popleft()
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+
+class DirQueue:
+    """Spool-directory queue; items ordered by (timestamp, uuid) name."""
+
+    def __init__(self, path: str, maxlen: Optional[int] = None):
+        self.path = path
+        self._maxlen = maxlen
+        os.makedirs(path, exist_ok=True)
+
+    def put(self, item: bytes) -> bool:
+        if self._maxlen is not None and len(self) >= self._maxlen:
+            return False
+        name = f"{time.time_ns():020d}-{uuid.uuid4().hex}"
+        tmp = os.path.join(self.path, f".{name}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(item)
+        os.replace(tmp, os.path.join(self.path, name + ".item"))
+        return True
+
+    def get(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        deadline = time.time() + (timeout or 0)
+        while True:
+            for name in sorted(os.listdir(self.path)):
+                if not name.endswith(".item"):
+                    continue
+                src = os.path.join(self.path, name)
+                claimed = os.path.join(self.path, name + ".claimed")
+                try:
+                    os.rename(src, claimed)  # atomic claim
+                except OSError:
+                    continue  # another consumer won
+                with open(claimed, "rb") as f:
+                    data = f.read()
+                os.unlink(claimed)
+                return data
+            if timeout is None or time.time() >= deadline:
+                return None
+            time.sleep(0.005)
+
+    def __len__(self) -> int:
+        return sum(1 for n in os.listdir(self.path)
+                   if n.endswith(".item"))
+
+
+def _make_backend(backend, path: Optional[str], maxlen: Optional[int]):
+    if backend == "memory" or (backend is None and path is None):
+        return MemQueue(maxlen)
+    if backend == "dir" or path is not None:
+        return DirQueue(path, maxlen)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+class InputQueue:
+    """(ref: client.py InputQueue.enqueue/predict)."""
+
+    def __init__(self, backend=None, path: Optional[str] = None,
+                 maxlen: Optional[int] = 10000, queue=None):
+        self._q = queue if queue is not None else _make_backend(
+            backend, path, maxlen)
+
+    @property
+    def queue(self):
+        return self._q
+
+    def enqueue(self, uri: str, **tensors) -> bool:
+        """False means the queue is full (backpressure; the reference
+        surfaces Redis OOM errors here, client.py:176-192)."""
+        return self._q.put(_encode(uri, tensors))
+
+    def __len__(self):
+        return len(self._q)
+
+
+class OutputQueue:
+    """(ref: client.py OutputQueue.dequeue/query)."""
+
+    def __init__(self, backend=None, path: Optional[str] = None,
+                 maxlen: Optional[int] = None, queue=None):
+        self._q = queue if queue is not None else _make_backend(
+            backend, path, maxlen)
+
+    @property
+    def queue(self):
+        return self._q
+
+    def dequeue(self, timeout: Optional[float] = None
+                ) -> Optional[Tuple[str, Dict[str, np.ndarray]]]:
+        blob = self._q.get(timeout)
+        return None if blob is None else _decode(blob)
+
+    def dequeue_all(self) -> List[Tuple[str, Dict[str, np.ndarray]]]:
+        out = []
+        while True:
+            item = self.dequeue(timeout=0)
+            if item is None:
+                return out
+            out.append(item)

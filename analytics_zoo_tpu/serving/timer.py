@@ -1,0 +1,73 @@
+"""Per-stage timing statistics.
+
+The analog of serving ``Timer`` (ref: zoo/.../serving/engine/Timer.scala:
+24-90 -- total/avg/max/min/top-10 per stage, printed periodically) and the
+``Supportive.timing`` wrapper (ref: zoo/.../serving/utils/Supportive.scala).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List
+
+
+class _StageStat:
+    __slots__ = ("count", "total", "max", "min", "top")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+        self.top: List[float] = []  # min-heap of the 10 largest
+
+    def record(self, dt: float) -> None:
+        self.count += 1
+        self.total += dt
+        self.max = max(self.max, dt)
+        self.min = min(self.min, dt)
+        if len(self.top) < 10:
+            heapq.heappush(self.top, dt)
+        else:
+            heapq.heappushpop(self.top, dt)
+
+
+class Timer:
+    def __init__(self):
+        self._stats: Dict[str, _StageStat] = {}
+        self._lock = threading.Lock()
+
+    @contextmanager
+    def timing(self, name: str, batch: int = 1):
+        """(ref: Supportive.scala timing)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._stats.setdefault(name, _StageStat()).record(dt)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            out = {}
+            for name, s in self._stats.items():
+                if not s.count:
+                    continue
+                out[name] = {
+                    "count": s.count,
+                    "total_s": s.total,
+                    "avg_s": s.total / s.count,
+                    "max_s": s.max,
+                    "min_s": s.min,
+                    "top10_avg_s": (sum(s.top) / len(s.top)
+                                    if s.top else 0.0),
+                }
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()

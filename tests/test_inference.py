@@ -1,0 +1,138 @@
+"""Inference runtime tests: loaders, shape buckets, thread safety,
+quantization, encryption, torch import."""
+
+import threading
+
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.inference import (
+    InferenceModel, decrypt_bytes, encrypt_bytes, import_torch_state_dict,
+    quantize_params, dequantize_params,
+)
+from analytics_zoo_tpu.models import NeuralCF, ZooModel
+
+
+class SmallNet(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(4)(nn.relu(nn.Dense(64)(x)))
+
+
+def trained_zoo_model(tmp_path):
+    rng = np.random.RandomState(0)
+    u = rng.randint(1, 21, 128)
+    i = rng.randint(1, 11, 128)
+    x = np.stack([u, i], 1).astype(np.int32)
+    y = ((u % 3) + 1).astype(np.int32)
+    m = NeuralCF(20, 10, class_num=4)
+    m.fit((x, y), batch_size=32, epochs=1)
+    path = str(tmp_path / "zoo")
+    m.save_model(path)
+    return m, path, x
+
+
+class TestInferenceModel:
+    def test_load_zoo_and_bucketing(self, tmp_path):
+        m, path, x = trained_zoo_model(tmp_path)
+        inf = InferenceModel()
+        inf.load_zoo(path)
+        ref = m.predict(x[:40], batch_size=8)
+        out = inf.predict(x[:40])  # 40 -> bucket 64, truncated back
+        assert out.shape == (40, 4)
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+        # same bucket reused for different n
+        out2 = inf.predict(x[:33])
+        assert out2.shape == (33, 4)
+        assert len(inf._compiled) == 1
+
+    def test_thread_safety(self, tmp_path):
+        _, path, x = trained_zoo_model(tmp_path)
+        inf = InferenceModel(concurrent_num=4)
+        inf.load_zoo(path)
+        results, errors = [None] * 8, []
+
+        def worker(k):
+            try:
+                results[k] = inf.predict(x[:16])
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert not errors
+        for r in results[1:]:
+            np.testing.assert_allclose(r, results[0], atol=1e-6)
+
+    def test_load_flax_variables(self):
+        import jax
+
+        net = SmallNet()
+        x = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+        variables = net.init(jax.random.PRNGKey(0), x)
+        inf = InferenceModel().load_flax(net, variables=variables)
+        out = inf.predict(x)
+        np.testing.assert_allclose(out, np.asarray(net.apply(variables, x)),
+                                   atol=1e-6)
+
+    def test_quantize_close_to_fp(self):
+        import jax
+
+        net = SmallNet()
+        x = np.random.RandomState(0).randn(16, 6).astype(np.float32)
+        variables = net.init(jax.random.PRNGKey(0), x)
+        inf = InferenceModel().load_flax(net, variables=variables)
+        ref = inf.predict(x)
+        inf.quantize(min_size=1)
+        out = inf.predict(x)
+        # int8 weight quantization stays within ~1% relative error
+        denom = np.maximum(np.abs(ref).max(), 1e-6)
+        assert np.max(np.abs(out - ref)) / denom < 0.05
+
+    def test_encrypted_roundtrip(self, tmp_path):
+        m, path, x = trained_zoo_model(tmp_path)
+        enc_dir = str(tmp_path / "enc")
+        InferenceModel.save_encrypted(path + "/weights", enc_dir,
+                                      "secret123")
+        # single-file sanity: wrong secret fails
+        blob = encrypt_bytes(b"hello world", "pw")
+        assert decrypt_bytes(blob, "pw") == b"hello world"
+        with pytest.raises(Exception):
+            decrypt_bytes(blob, "wrong")
+
+    def test_torch_import(self):
+        torch = pytest.importorskip("torch")
+
+        lin = torch.nn.Linear(6, 4)
+        sd = lin.state_dict()
+        params = import_torch_state_dict(
+            {"dense.weight": sd["weight"], "dense.bias": sd["bias"]})
+        assert params["dense"]["kernel"].shape == (6, 4)
+
+        class TorchLike(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(4, name="dense")(x)
+
+        inf = InferenceModel().load_torch(TorchLike(),
+                                          {"dense.weight": sd["weight"],
+                                           "dense.bias": sd["bias"]})
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        want = lin(torch.tensor(x)).detach().numpy()
+        np.testing.assert_allclose(inf.predict(x), want, atol=1e-5)
+
+
+class TestQuantizeUnit:
+    def test_roundtrip_small_passthrough(self):
+        params = {"w": np.random.randn(64, 64).astype(np.float32),
+                  "b": np.random.randn(64).astype(np.float32)}
+        q, scales = quantize_params(params, min_size=1024)
+        assert q["w"].dtype == np.int8
+        assert q["b"].dtype == np.float32  # too small / 1-D: passthrough
+        dq = dequantize_params(q, scales)
+        err = np.max(np.abs(np.asarray(dq["w"]) - params["w"]))
+        assert err <= np.abs(params["w"]).max() / 127 + 1e-6
+        np.testing.assert_allclose(np.asarray(dq["b"]), params["b"])
