@@ -1,0 +1,267 @@
+"""HTTP frontend: /predict + /metrics over the serving queues.
+
+The analog of the akka-http frontend (ref: zoo/.../serving/http/
+FrontEndApp.scala:40-130 -- a /predict route that XADDs the request into
+Redis, awaits the result stream, and a /metrics route exposing timer
+percentiles). Here: a stdlib ``ThreadingHTTPServer``; each /predict POST
+enqueues into the InputQueue with a fresh uri, a router thread drains the
+OutputQueue into per-uri mailboxes, and the handler blocks on its mailbox
+with a deadline. Dependency-free JSON wire format:
+
+  POST /predict  {"inputs": {"x": [[...]]}}            -> {"predictions": ...}
+  POST /predict  {"instances": [{"x": [...]}, ...]}    -> {"predictions": [...]}
+  GET  /metrics                                        -> stage timers + queue depths
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.serving.timer import Timer
+from analytics_zoo_tpu.serving.worker import ERROR_KEY
+
+logger = get_logger(__name__)
+
+
+class _ResultRouter:
+    """Drains the OutputQueue into per-uri mailboxes. Only uris
+    registered as pending get a mailbox; results for abandoned uris
+    (request already timed out) are dropped, so timeouts don't leak."""
+
+    def __init__(self, output_queue):
+        self._q = output_queue
+        self._pending: set = set()
+        self._results: Dict[str, Dict[str, np.ndarray]] = {}
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self, join_timeout: float = 5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(join_timeout)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.is_set():
+            item = self._q.dequeue(timeout=0.05)
+            if item is None:
+                continue
+            uri, tensors = item
+            with self._cv:
+                if uri in self._pending:
+                    self._results[uri] = tensors
+                    self._cv.notify_all()
+                else:
+                    logger.warning("dropping result for abandoned "
+                                   "request %s", uri)
+
+    def register(self, uri: str) -> None:
+        with self._cv:
+            self._pending.add(uri)
+
+    def unregister(self, uri: str) -> None:
+        """Abandon a registered uri (request failed before/without its
+        wait): drop the mailbox so late results can't accumulate."""
+        with self._cv:
+            self._pending.discard(uri)
+            self._results.pop(uri, None)
+
+    def wait(self, uri: str, timeout: float
+             ) -> Optional[Dict[str, np.ndarray]]:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            try:
+                while uri not in self._results:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._cv.wait(remaining)
+                return self._results.pop(uri)
+            finally:
+                self._pending.discard(uri)
+
+
+def _to_jsonable(tensors: Dict[str, np.ndarray]) -> Any:
+    out = {}
+    for k, v in tensors.items():
+        a = np.asarray(v)
+        if a.dtype.kind == "f" and not np.all(np.isfinite(a)):
+            # json.dumps would emit bare NaN/Infinity tokens (invalid
+            # JSON); strict clients can't parse that. Map to null.
+            a = np.where(np.isfinite(a), a.astype(object), None)
+        out[k] = a.item() if a.ndim == 0 else a.tolist()
+    return out
+
+
+class HttpFrontend:
+    """Serve /predict + /metrics on ``host:port``.
+
+    Args:
+      input_queue / output_queue: the serving queues; the frontend OWNS
+        the output queue (its router consumes every result).
+      worker: optional ServingWorker whose metrics join /metrics.
+      request_timeout: /predict deadline in seconds (ref:
+        FrontEndApp timeout settings).
+    """
+
+    def __init__(self, input_queue, output_queue, host: str = "127.0.0.1",
+                 port: int = 0, worker=None,
+                 request_timeout: float = 10.0,
+                 timer: Optional[Timer] = None):
+        self._in = input_queue
+        self.router = _ResultRouter(output_queue)
+        self.worker = worker
+        self.request_timeout = request_timeout
+        self.timer = timer or Timer()
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to our logger
+                logger.debug("http: " + fmt, *args)
+
+            def _reply(self, code: int, payload: Any):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._reply(200, frontend.metrics())
+                elif self.path == "/":
+                    # welcome route (ref: FrontEndApp.scala:40)
+                    self._reply(200, {"message": "welcome to analytics "
+                                                 "zoo tpu serving"})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                with frontend.timer.timing("predict_request"):
+                    code, payload = frontend.handle_predict(req)
+                self._reply(code, payload)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server_thread: Optional[threading.Thread] = None
+
+    # --------------------------------------------------------- requests --
+    def handle_predict(self, req: Any):
+        if not isinstance(req, dict):
+            return 400, {"error": "body must be a JSON object"}
+        if "instances" in req:
+            instances = req["instances"]
+            if not isinstance(instances, list):
+                return 400, {"error": "'instances' must be a list"}
+            single = False
+        elif "inputs" in req:
+            instances, single = [req["inputs"]], True
+        else:
+            return 400, {"error": "body must carry 'inputs' or "
+                                  "'instances'"}
+        # enqueue everything first so the worker's micro-batcher can
+        # stack the whole request into device batches, then await; one
+        # deadline covers the whole request
+        deadline = time.monotonic() + self.request_timeout
+        uris: list = []
+        try:
+            code, payload = self._enqueue_many(instances, uris)
+            if code != 200:
+                return code, payload
+            preds = []
+            for i, uri in enumerate(uris):
+                code, payload = self._await(uri, deadline)
+                uris[i] = None  # awaited: wait() owns the cleanup now
+                if code != 200:
+                    return code, payload
+                preds.append(payload)
+            return 200, {"predictions": preds[0] if single else preds}
+        finally:
+            for uri in uris:  # abandon whatever was never awaited
+                if uri is not None:
+                    self.router.unregister(uri)
+
+    def _enqueue_many(self, instances, uris: list):
+        for inputs in instances:
+            if not isinstance(inputs, dict) or not inputs:
+                return 400, {"error": "inputs must be a non-empty object"}
+            try:
+                tensors = {k: np.asarray(v) for k, v in inputs.items()}
+            except (ValueError, TypeError) as e:
+                return 400, {"error": f"bad tensor: {e}"}
+            for k, a in tensors.items():
+                if a.dtype.kind not in "biufc":
+                    return 400, {"error": f"tensor {k!r} is ragged or "
+                                          "non-numeric"}
+            uri = uuid.uuid4().hex
+            self.router.register(uri)
+            uris.append(uri)
+            if not self._in.enqueue(uri, **tensors):
+                # bounded-queue backpressure -> 503 (the reference
+                # surfaces Redis OOM as an error, FrontEndApp/client.py)
+                return 503, {"error": "input queue full"}
+        return 200, None
+
+    def _await(self, uri: str, deadline: float):
+        result = self.router.wait(
+            uri, max(0.0, deadline - time.monotonic()))
+        if result is None:
+            return 504, {"error": "prediction timed out"}
+        if ERROR_KEY in result:
+            return 500, {"error": str(result[ERROR_KEY])}
+        return 200, _to_jsonable(result)
+
+    # -------------------------------------------------------- lifecycle --
+    @property
+    def address(self):
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "HttpFrontend":
+        self.router.start()
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._server_thread.start()
+        logger.info("serving frontend at %s", self.address)
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        if self._server_thread is not None:
+            self._server_thread.join(5.0)
+            self._server_thread = None
+        self.router.stop()
+        self._server.server_close()
+
+    def metrics(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"frontend": self.timer.summary()}
+        try:
+            out["input_queue_depth"] = len(self._in)
+        except TypeError:
+            pass
+        if self.worker is not None:
+            out["worker"] = self.worker.metrics()
+        return out

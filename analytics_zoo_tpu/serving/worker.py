@@ -1,0 +1,209 @@
+"""ServingWorker: the inference engine of the serving data plane.
+
+The analog of the Flink inference task (ref: zoo/.../serving/engine/
+FlinkInference.scala:32-80 -- per-TM singleton InferenceModel fed by
+micro-batches from the Redis source; batching logic in
+engine/ClusterServingInference.scala:33-160). The TPU redesign runs one
+worker loop per serving host: pull from an InputQueue via MicroBatcher,
+stack request tensors into one padded device batch, run the AOT-cached
+``InferenceModel.predict``, split results back per-request and push them
+to the OutputQueue. Every stage is Timer-instrumented (ref:
+serving/engine/Timer.scala:24-90).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.serving.batcher import MicroBatcher
+from analytics_zoo_tpu.serving.queues import _decode, _encode
+from analytics_zoo_tpu.serving.timer import Timer
+
+logger = get_logger(__name__)
+
+ERROR_KEY = "__error__"
+
+
+def _default_input_fn(tensors: Dict[str, np.ndarray]) -> Any:
+    """Map a request's named tensors to a model input pytree: a single
+    tensor stays bare; several become a tuple in sorted-name order (the
+    positional-args convention of the Estimator's multi-input models)."""
+    if len(tensors) == 1:
+        return next(iter(tensors.values()))
+    return tuple(tensors[k] for k in sorted(tensors))
+
+
+def _default_output_fn(pred: Any) -> Dict[str, np.ndarray]:
+    """Map one request's slice of the model output back to named tensors
+    (ref: PostProcessing -- the reference base64-encodes; we keep arrays)."""
+    if isinstance(pred, dict):
+        return {k: np.asarray(v) for k, v in pred.items()}
+    if isinstance(pred, (tuple, list)):
+        return {f"output_{i}": np.asarray(p) for i, p in enumerate(pred)}
+    return {"output": np.asarray(pred)}
+
+
+class ServingWorker:
+    """Pulls, batches, predicts, pushes. Run inline (``serve_forever``),
+    one bounded number of batches (``run``), or on a daemon thread
+    (``start``/``stop``).
+
+    Args:
+      model: an ``InferenceModel`` (anything with ``predict(x)``).
+      input_queue / output_queue: ``InputQueue``/``OutputQueue`` (or any
+        object exposing their ``queue`` backend).
+      batch_size: micro-batch cap (ref: ClusterServingHelper coreNumber
+        as batch size).
+      timeout_ms: linger after the first request of a batch.
+      input_fn / output_fn: request-tensors -> model-input pytree and
+        model-output-slice -> response-tensors hooks (PreProcessing /
+        PostProcessing analogs).
+      top_n: if set, responses carry ``classes``/``scores`` of the top-N
+        logits instead of the raw output (ref: PostProcessing topN).
+    """
+
+    def __init__(self, model, input_queue, output_queue,
+                 batch_size: int = 8, timeout_ms: float = 5.0,
+                 input_fn: Callable = _default_input_fn,
+                 output_fn: Callable = _default_output_fn,
+                 top_n: Optional[int] = None,
+                 timer: Optional[Timer] = None):
+        self.model = model
+        self._in = getattr(input_queue, "queue", input_queue)
+        self._out_q = output_queue
+        self.batcher = MicroBatcher(self._in, batch_size=batch_size,
+                                    timeout_ms=timeout_ms)
+        self.input_fn = input_fn
+        self.output_fn = output_fn
+        self.top_n = top_n
+        self.timer = timer or Timer()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.served = 0
+
+    # ------------------------------------------------------------ loop --
+    def process_one_batch(self, wait_timeout: float = 1.0) -> int:
+        """One pull→predict→push cycle; returns requests served."""
+        with self.timer.timing("batch_wait"):
+            blobs = self.batcher.next_batch(wait_timeout=wait_timeout)
+        if not blobs:
+            return 0
+        with self.timer.timing("decode", batch=len(blobs)):
+            items: List[Tuple[str, Dict[str, np.ndarray]]] = []
+            for b in blobs:
+                try:
+                    items.append(_decode(b))
+                except Exception as e:  # malformed blob: drop, keep serving
+                    logger.exception("serving: undecodable request "
+                                     "dropped: %s", e)
+        groups = self._group_compatible(items)
+        n = 0
+        for group in groups:
+            try:
+                n += self._predict_group(group)
+            except Exception as e:  # input_fn/output_fn bugs must not
+                logger.exception(  # kill the serving thread
+                    "serving batch failed: %s", e)
+                for uri, _ in group:
+                    self._push_error(uri, str(e))
+                n += len(group)
+        self.served += n
+        return n
+
+    @staticmethod
+    def _group_compatible(items):
+        """Group requests whose tensors share keys+shapes+dtypes so they
+        stack into one device batch (ref: batchInput groups by model
+        signature implicitly -- one model, one schema)."""
+        groups: Dict[Any, List] = {}
+        for uri, tensors in items:
+            sig = tuple(sorted((k, v.shape, str(v.dtype))
+                               for k, v in tensors.items()))
+            groups.setdefault(sig, []).append((uri, tensors))
+        return list(groups.values())
+
+    def _predict_group(self, group) -> int:
+        uris = [u for u, _ in group]
+        with self.timer.timing("stack", batch=len(group)):
+            stacked = {
+                k: np.stack([t[k] for _, t in group])
+                for k in group[0][1]
+            }
+            x = self.input_fn(stacked)
+        try:
+            with self.timer.timing("predict", batch=len(group)):
+                preds = self.model.predict(x)
+        except Exception as e:  # push per-request errors, keep serving
+            logger.exception("serving predict failed: %s", e)
+            for uri in uris:
+                self._push_error(uri, str(e))
+            return len(group)
+        with self.timer.timing("postprocess", batch=len(group)):
+            for i, uri in enumerate(uris):
+                pred_i = _tree_index(preds, i)
+                if self.top_n is not None:
+                    pred_i = _top_n(np.asarray(pred_i), self.top_n)
+                    self._push(uri, pred_i)
+                else:
+                    self._push(uri, self.output_fn(pred_i))
+        return len(group)
+
+    def _push(self, uri: str, tensors: Dict[str, np.ndarray]) -> None:
+        backend = getattr(self._out_q, "queue", self._out_q)
+        if not backend.put(_encode(uri, tensors)):
+            logger.warning("output queue full: dropping result for %s",
+                           uri)
+
+    def _push_error(self, uri: str, message: str) -> None:
+        # reserved out-of-band key (the "__uri__" convention of
+        # queues._encode) so model outputs named "error" stay usable
+        self._push(uri, {ERROR_KEY: np.asarray(message)})
+
+    def run(self, max_batches: Optional[int] = None,
+            wait_timeout: float = 0.05) -> int:
+        """Serve until stopped (or ``max_batches`` cycles); returns total
+        requests served in this call."""
+        total = 0
+        batches = 0
+        while not self._stop.is_set():
+            total += self.process_one_batch(wait_timeout=wait_timeout)
+            batches += 1
+            if max_batches is not None and batches >= max_batches:
+                break
+        return total
+
+    def serve_forever(self) -> None:
+        self.run()
+
+    def start(self) -> "ServingWorker":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(join_timeout)
+            self._thread = None
+
+    def metrics(self) -> Dict[str, Any]:
+        return {"served": self.served, "stages": self.timer.summary()}
+
+
+def _tree_index(preds, i: int):
+    import jax
+
+    return jax.tree_util.tree_map(lambda a: np.asarray(a)[i], preds)
+
+
+def _top_n(logits: np.ndarray, n: int) -> Dict[str, np.ndarray]:
+    """(ref: PostProcessing topN -- class indices + scores)."""
+    flat = logits.reshape(-1)
+    idx = np.argsort(flat)[::-1][:n]
+    return {"classes": idx.astype(np.int32), "scores": flat[idx]}

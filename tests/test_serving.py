@@ -1,0 +1,219 @@
+"""Serving end-to-end tests: queues, batcher, worker, HTTP frontend.
+
+The analog of the reference's serving suite (ref: zoo/src/test/scala/...
+/serving/ -- MockClusterServing, CorrectnessSpec full pre/post/inference
+chain, FrontendActorsSpec; SURVEY.md section 4 "Serving tests with
+mocks").
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import flax.linen as nn
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.serving as serving
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.serving import (DirQueue, HttpFrontend, InputQueue,
+                                       MemQueue, MicroBatcher, OutputQueue,
+                                       ServingWorker)
+
+
+class _TinyNet(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(4)(x)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    model = InferenceModel()
+    module = _TinyNet()
+    import jax
+
+    variables = module.init(jax.random.PRNGKey(0), np.zeros((1, 3)))
+    model.load_flax(module, variables=variables)
+    return model
+
+
+def test_serving_package_imports():
+    # round-1 regression: serving/__init__ referenced missing modules
+    for name in ("InputQueue", "OutputQueue", "DirQueue", "MemQueue",
+                 "MicroBatcher", "ServingWorker", "HttpFrontend", "Timer"):
+        assert hasattr(serving, name)
+
+
+def test_mem_queue_roundtrip():
+    q = InputQueue(backend="memory")
+    out = OutputQueue(queue=q.queue)
+    assert q.enqueue("a", x=np.arange(3.0))
+    uri, tensors = out.dequeue(timeout=1)
+    assert uri == "a"
+    np.testing.assert_array_equal(tensors["x"], np.arange(3.0))
+
+
+def test_mem_queue_backpressure():
+    q = InputQueue(backend="memory", maxlen=2)
+    assert q.enqueue("a", x=np.zeros(1))
+    assert q.enqueue("b", x=np.zeros(1))
+    assert not q.enqueue("c", x=np.zeros(1))  # full -> False
+
+
+def test_dir_queue_concurrent_consumers(tmp_path):
+    """Two consumers racing on one DirQueue: every item claimed exactly
+    once (the atomic-rename contract replacing Redis consumer groups)."""
+    path = str(tmp_path / "spool")
+    q = DirQueue(path)
+    n = 40
+    for i in range(n):
+        InputQueue(queue=q).enqueue(f"item-{i}", x=np.asarray([float(i)]))
+
+    claimed, lock = [], threading.Lock()
+
+    def consume():
+        out = OutputQueue(queue=DirQueue(path))
+        while True:
+            item = out.dequeue(timeout=0.2)
+            if item is None:
+                return
+            with lock:
+                claimed.append(item[0])
+
+    threads = [threading.Thread(target=consume) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert sorted(claimed) == sorted(f"item-{i}" for i in range(n))
+    assert len(q) == 0
+
+
+def test_micro_batcher_groups_and_timeout():
+    q = MemQueue()
+    for i in range(5):
+        q.put(bytes([i]))
+    b = MicroBatcher(q, batch_size=3, timeout_ms=50)
+    assert len(b.next_batch()) == 3
+    assert len(b.next_batch()) == 2
+    assert b.next_batch(wait_timeout=0.01) == []
+
+
+def test_worker_end_to_end_dirqueue(tmp_path, tiny_model):
+    """enqueue -> worker batch/predict -> dequeue, results match a direct
+    predict call (the CorrectnessSpec analog)."""
+    in_q = InputQueue(path=str(tmp_path / "in"))
+    out_q = OutputQueue(path=str(tmp_path / "out"))
+    rng = np.random.RandomState(0)
+    xs = {f"req-{i}": rng.randn(3).astype(np.float32) for i in range(10)}
+    for uri, x in xs.items():
+        assert in_q.enqueue(uri, x=x)
+
+    worker = ServingWorker(tiny_model, in_q, out_q, batch_size=4,
+                           timeout_ms=20)
+    served = worker.run(max_batches=10, wait_timeout=0.05)
+    assert served == 10
+
+    results = dict(out_q.dequeue_all())
+    assert sorted(results) == sorted(xs)
+    direct = tiny_model.predict(np.stack(list(xs.values())))
+    for i, uri in enumerate(xs):
+        np.testing.assert_allclose(results[uri]["output"], direct[i],
+                                   rtol=1e-5)
+    stats = worker.metrics()["stages"]
+    assert stats["predict"]["count"] >= 1
+
+
+def test_worker_top_n(tiny_model):
+    in_q, out_q = InputQueue(), OutputQueue()
+    in_q.enqueue("r", x=np.ones(3, np.float32))
+    worker = ServingWorker(tiny_model, in_q, out_q, top_n=2)
+    worker.run(max_batches=1)
+    uri, tensors = out_q.dequeue(timeout=1)
+    assert tensors["classes"].shape == (2,)
+    assert tensors["scores"][0] >= tensors["scores"][1]
+
+
+def test_worker_survives_model_error():
+    class Broken:
+        def predict(self, x):
+            raise RuntimeError("boom")
+
+    in_q, out_q = InputQueue(), OutputQueue()
+    in_q.enqueue("bad", x=np.ones(3, np.float32))
+    worker = ServingWorker(Broken(), in_q, out_q)
+    worker.run(max_batches=1)
+    uri, tensors = out_q.dequeue(timeout=1)
+    from analytics_zoo_tpu.serving.worker import ERROR_KEY
+
+    assert uri == "bad" and "boom" in str(tensors[ERROR_KEY])
+
+
+def test_worker_survives_bad_input_fn(tiny_model):
+    """input_fn raising must not kill the loop (review finding: only
+    predict was guarded)."""
+    in_q, out_q = InputQueue(), OutputQueue()
+    in_q.enqueue("r1", x=np.ones(3, np.float32))
+    worker = ServingWorker(tiny_model, in_q, out_q,
+                           input_fn=lambda t: 1 / 0)
+    worker.run(max_batches=1)
+    from analytics_zoo_tpu.serving.worker import ERROR_KEY
+
+    uri, tensors = out_q.dequeue(timeout=1)
+    assert uri == "r1" and ERROR_KEY in tensors
+    # loop still alive: a good request after the bad one succeeds
+    in_q.enqueue("r2", x=np.ones(3, np.float32))
+    worker.input_fn = lambda t: next(iter(t.values()))
+    worker.run(max_batches=1)
+    uri, tensors = out_q.dequeue(timeout=1)
+    assert uri == "r2" and "output" in tensors
+
+
+@pytest.fixture()
+def http_stack(tiny_model):
+    in_q, out_q = InputQueue(maxlen=64), OutputQueue()
+    worker = ServingWorker(tiny_model, in_q, out_q, batch_size=8,
+                           timeout_ms=5).start()
+    frontend = HttpFrontend(in_q, out_q, worker=worker,
+                            request_timeout=15).start()
+    yield frontend
+    frontend.stop()
+    worker.stop()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=20) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_http_predict_and_metrics(http_stack, tiny_model):
+    x = [0.5, -1.0, 2.0]
+    status, body = _post(http_stack.address + "/predict",
+                         {"inputs": {"x": x}})
+    assert status == 200
+    direct = tiny_model.predict(np.asarray([x], np.float32))[0]
+    np.testing.assert_allclose(body["predictions"]["output"], direct,
+                               rtol=1e-4)
+
+    status, body = _post(http_stack.address + "/predict",
+                         {"instances": [{"x": x}, {"x": x}]})
+    assert status == 200 and len(body["predictions"]) == 2
+
+    with urllib.request.urlopen(http_stack.address + "/metrics",
+                                timeout=10) as resp:
+        metrics = json.loads(resp.read())
+    assert metrics["worker"]["served"] >= 3
+    assert "predict_request" in metrics["frontend"]
+
+
+def test_http_bad_request(http_stack):
+    for bad in ({"nope": 1}, 5, [1, 2], {"instances": 3},
+                {"inputs": {"x": [[1], [2, 3]]}}):
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _post(http_stack.address + "/predict", bad)
+        assert exc_info.value.code == 400, bad
