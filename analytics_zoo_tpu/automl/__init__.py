@@ -1,0 +1,42 @@
+"""AutoML for time series: search engine, recipes, feature pipeline.
+
+The analog of the reference AutoML subsystem (ref: pyzoo/zoo/automl --
+RayTuneSearchEngine + recipes + TimeSequenceFeatureTransformer + tunable
+models + TimeSequencePipeline; SURVEY.md section 2.2). Search runs on
+host CPUs (trials are small models); the TPU chip serves the final
+refit/inference path.
+"""
+
+from analytics_zoo_tpu.automl.feature import (  # noqa: F401
+    TimeSequenceFeatureTransformer,
+)
+from analytics_zoo_tpu.automl.models import (  # noqa: F401
+    MTNet,
+    Seq2SeqForecaster,
+    TCN,
+    TimeSequenceModel,
+    VanillaLSTM,
+    build_forecast_module,
+)
+from analytics_zoo_tpu.automl.pipeline import (  # noqa: F401
+    TimeSequencePipeline,
+    load_ts_pipeline,
+)
+from analytics_zoo_tpu.automl.predictor import (  # noqa: F401
+    TimeSequencePredictor,
+)
+from analytics_zoo_tpu.automl.recipes import (  # noqa: F401
+    GridRandomRecipe,
+    LSTMGridRandomRecipe,
+    MTNetGridRandomRecipe,
+    Recipe,
+    Seq2SeqRandomRecipe,
+    SmokeRecipe,
+    TCNGridRandomRecipe,
+)
+from analytics_zoo_tpu.automl.search import (  # noqa: F401
+    SearchEngine,
+    TrialOutput,
+)
+from analytics_zoo_tpu.automl import metrics  # noqa: F401
+from analytics_zoo_tpu.automl import space  # noqa: F401

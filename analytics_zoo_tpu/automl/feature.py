@@ -1,0 +1,245 @@
+"""Time-series feature engineering for AutoML.
+
+The analog of ``TimeSequenceFeatureTransformer`` (ref: pyzoo/zoo/automl/
+feature/time_sequence.py:35-583 -- datetime feature generation via
+featuretools, standard scaling, rolling past/future windows) rebuilt on
+plain pandas/numpy: the generated calendar features are closed-form, so
+no feature-synthesis library is needed, and the rolled windows come out
+as dense [N, past_seq_len, F] float32 blocks ready for device upload.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+# calendar features derivable from the datetime column; the awake/busy
+# bands mirror the reference's is_awake/is_busy_hours definitions
+# (ref: feature/time_sequence.py:545-556)
+_DT_FEATURES = ("month", "day", "hour", "minute", "weekday",
+                "is_weekend", "is_awake", "is_busy_hours")
+
+
+def _datetime_features(dt: pd.Series) -> pd.DataFrame:
+    hour = dt.dt.hour
+    weekday = dt.dt.weekday
+    return pd.DataFrame({
+        "month": dt.dt.month,
+        "day": dt.dt.day,
+        "hour": hour,
+        "minute": dt.dt.minute,
+        "weekday": weekday,
+        "is_weekend": (weekday >= 5).astype(int),
+        "is_awake": (((hour >= 6) & (hour <= 23)) | (hour == 0))
+        .astype(int),
+        "is_busy_hours": (((hour >= 7) & (hour <= 9)) |
+                          ((hour >= 16) & (hour <= 19))).astype(int),
+    }, index=dt.index)
+
+
+def _as_list(v) -> List[str]:
+    if v is None:
+        return []
+    if isinstance(v, str):
+        return list(json.loads(v))
+    return list(v)
+
+
+class TimeSequenceFeatureTransformer:
+    """df[(dt_col, target_col, extra...)] -> rolled (x, y) windows.
+
+    Config keys consumed from the search space:
+      ``selected_features``: subset of :meth:`get_feature_list` (JSON
+      string or list); ``past_seq_len``: history window length.
+    """
+
+    def __init__(self, future_seq_len: int = 1, dt_col: str = "datetime",
+                 target_col="value", extra_features_col=None,
+                 drop_missing: bool = True):
+        self.future_seq_len = future_seq_len
+        self.dt_col = dt_col
+        self.target_col = ([target_col] if isinstance(target_col, str)
+                           else list(target_col))
+        self.extra_features_col = _as_list(extra_features_col)
+        self.drop_missing = drop_missing
+        self.config: Dict[str, Any] = {}
+        self.scale_mean: Optional[np.ndarray] = None
+        self.scale_std: Optional[np.ndarray] = None
+
+    # -------------------------------------------------------- features --
+    def get_feature_list(self, input_df: pd.DataFrame = None) -> List[str]:
+        return list(_DT_FEATURES) + list(self.extra_features_col)
+
+    def _check_input(self, df: pd.DataFrame, mode: str) -> pd.DataFrame:
+        need = [self.dt_col] + self.target_col + self.extra_features_col
+        missing = set(need) - set(df.columns)
+        if missing:
+            raise ValueError(f"missing columns: {sorted(missing)}")
+        df = df.copy()
+        df[self.dt_col] = pd.to_datetime(df[self.dt_col])
+        if df[self.dt_col].isna().any():
+            raise ValueError("datetime column has missing values")
+        value_cols = self.target_col + self.extra_features_col
+        if df[value_cols].isna().any().any():
+            if self.drop_missing:
+                df = df.dropna(subset=value_cols)
+            else:
+                # last-observation fill, then backfill for a leading NaN
+                # (ref: impute/impute.py LastFillImpute)
+                df[value_cols] = df[value_cols].ffill().bfill()
+        if len(df) == 0:
+            raise ValueError("empty dataframe after dropping missing")
+        return df.reset_index(drop=True)
+
+    def _feature_matrix(self, df: pd.DataFrame,
+                        selected: Sequence[str]) -> np.ndarray:
+        """[N, n_targets + n_selected] in float32; targets lead."""
+        dt_feats = _datetime_features(df[self.dt_col])
+        cols = [df[c].to_numpy(np.float32) for c in self.target_col]
+        for name in selected:
+            if name in dt_feats.columns:
+                cols.append(dt_feats[name].to_numpy(np.float32))
+            elif name in df.columns:
+                cols.append(df[name].to_numpy(np.float32))
+            else:
+                raise ValueError(f"unknown feature {name!r}")
+        return np.stack(cols, axis=1)
+
+    # --------------------------------------------------------- scaling --
+    def _fit_scaler(self, mat: np.ndarray) -> None:
+        self.scale_mean = mat.mean(axis=0)
+        std = mat.std(axis=0)
+        self.scale_std = np.where(std < 1e-8, 1.0, std)
+
+    def _scale(self, mat: np.ndarray) -> np.ndarray:
+        return (mat - self.scale_mean) / self.scale_std
+
+    def _unscale_y(self, y: np.ndarray) -> np.ndarray:
+        """y [..., n_targets]: invert scaling with the target stats."""
+        t = len(self.target_col)
+        return y * self.scale_std[:t] + self.scale_mean[:t]
+
+    def unscale_uncertainty(self, y_std: np.ndarray) -> np.ndarray:
+        t = len(self.target_col)
+        return y_std * self.scale_std[:t]
+
+    # --------------------------------------------------------- rolling --
+    def _roll(self, mat: np.ndarray, past: int, future: int):
+        """[N, F] -> x [M, past, F], y [M, future, T] (targets lead)."""
+        t = len(self.target_col)
+        n = len(mat) - past - future + 1
+        if n <= 0:
+            raise ValueError(
+                f"series of {len(mat)} rows too short for past_seq_len="
+                f"{past} + future_seq_len={future}")
+        x = np.stack([mat[i:i + past] for i in range(n)])
+        y = np.stack([mat[i + past:i + past + future, :t]
+                      for i in range(n)])
+        return x.astype(np.float32), y.astype(np.float32)
+
+    def _roll_test(self, mat: np.ndarray, past: int) -> np.ndarray:
+        n = len(mat) - past + 1
+        if n <= 0:
+            raise ValueError("series too short for past_seq_len")
+        return np.stack([mat[i:i + past] for i in range(n)]
+                        ).astype(np.float32)
+
+    # ------------------------------------------------------- transform --
+    def fit_transform(self, input_df: pd.DataFrame, **config):
+        """Fit scaler + remember config, return rolled (x, y)
+        (ref: time_sequence.py fit_transform)."""
+        self.config = dict(config)
+        selected = _as_list(config.get("selected_features", []))
+        past = int(config.get("past_seq_len", 2))
+        df = self._check_input(input_df, "train")
+        mat = self._feature_matrix(df, selected)
+        self._fit_scaler(mat)
+        return self._roll(self._scale(mat), past, self.future_seq_len)
+
+    def transform(self, input_df: pd.DataFrame, is_train: bool = False):
+        """Transform with the fitted scaler/config. Train mode returns
+        (x, y); test mode returns x covering every full history window."""
+        if self.scale_mean is None:
+            raise RuntimeError("call fit_transform first")
+        selected = _as_list(self.config.get("selected_features", []))
+        past = int(self.config.get("past_seq_len", 2))
+        df = self._check_input(input_df, "train" if is_train else "test")
+        mat = self._scale(self._feature_matrix(df, selected))
+        if is_train:
+            return self._roll(mat, past, self.future_seq_len)
+        return self._roll_test(mat, past)
+
+    def post_processing(self, input_df: pd.DataFrame, y_pred: np.ndarray,
+                        is_train: bool):
+        """Invert scaling. Train mode: (y_pred_unscaled, y_true_unscaled)
+        for metric computation; test mode: a dataframe mapping each
+        prediction window to the datetime it forecasts
+        (ref: time_sequence.py post_processing)."""
+        t = len(self.target_col)
+        y_pred = y_pred.reshape(len(y_pred), self.future_seq_len, t)
+        y_unscaled = self._unscale_y(y_pred)
+        if is_train:
+            df = self._check_input(input_df, "train")
+            selected = _as_list(self.config.get("selected_features", []))
+            past = int(self.config.get("past_seq_len", 2))
+            mat = self._feature_matrix(df, selected)
+            _, y_true = self._roll(mat, past, self.future_seq_len)
+            return y_unscaled, y_true
+        df = self._check_input(input_df, "test")
+        past = int(self.config.get("past_seq_len", 2))
+        dt = pd.to_datetime(df[self.dt_col])
+        freq = dt.iloc[-1] - dt.iloc[-2] if len(dt) > 1 else pd.Timedelta(0)
+        first_pred_dt = dt.iloc[past - 1:].reset_index(drop=True) + freq
+        out = {self.dt_col: first_pred_dt}
+        for j, col in enumerate(self.target_col):
+            for h in range(self.future_seq_len):
+                name = col if self.future_seq_len == 1 else f"{col}_{h}"
+                out[name] = y_unscaled[:, h, j]
+        return pd.DataFrame(out)
+
+    # ----------------------------------------------------- persistence --
+    def save(self, dir_path: str) -> None:
+        os.makedirs(dir_path, exist_ok=True)
+        meta = {
+            "future_seq_len": self.future_seq_len,
+            "dt_col": self.dt_col,
+            "target_col": self.target_col,
+            "extra_features_col": self.extra_features_col,
+            "drop_missing": self.drop_missing,
+            "config": _jsonable(self.config),
+        }
+        with open(os.path.join(dir_path, "feature_transformer.json"),
+                  "w") as f:
+            json.dump(meta, f)
+        np.savez(os.path.join(dir_path, "feature_scaler.npz"),
+                 mean=self.scale_mean, std=self.scale_std)
+
+    @classmethod
+    def restore(cls, dir_path: str) -> "TimeSequenceFeatureTransformer":
+        with open(os.path.join(dir_path, "feature_transformer.json")) as f:
+            meta = json.load(f)
+        ft = cls(future_seq_len=meta["future_seq_len"],
+                 dt_col=meta["dt_col"], target_col=meta["target_col"],
+                 extra_features_col=meta["extra_features_col"],
+                 drop_missing=meta["drop_missing"])
+        ft.config = meta["config"]
+        with np.load(os.path.join(dir_path, "feature_scaler.npz")) as z:
+            ft.scale_mean, ft.scale_std = z["mean"], z["std"]
+        return ft
+
+
+def _jsonable(config: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in config.items():
+        if isinstance(v, (np.integer,)):
+            v = int(v)
+        elif isinstance(v, (np.floating,)):
+            v = float(v)
+        elif isinstance(v, np.ndarray):
+            v = v.tolist()
+        out[k] = v
+    return out

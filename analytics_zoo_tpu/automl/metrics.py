@@ -1,0 +1,101 @@
+"""Regression / forecasting metrics for AutoML model selection.
+
+The analog of the reference's metric table (ref: pyzoo/zoo/automl/common/
+metrics.py -- ME/MAE/MSE/RMSE/MSLE/R2/MPE/MAPE/sMAPE evaluated on numpy
+arrays). These run on host numpy: they score whole validation sets once
+per trial, not inner training steps, so there is nothing to jit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+EPSILON = 1e-10
+
+
+def _flatten(y_true, y_pred):
+    y_true = np.asarray(y_true, np.float64).reshape(-1)
+    y_pred = np.asarray(y_pred, np.float64).reshape(-1)
+    if y_true.shape != y_pred.shape:
+        raise ValueError(f"shape mismatch: {y_true.shape} vs "
+                         f"{y_pred.shape}")
+    return y_true, y_pred
+
+
+def me(y_true, y_pred):
+    y_true, y_pred = _flatten(y_true, y_pred)
+    return float(np.mean(y_pred - y_true))
+
+
+def mae(y_true, y_pred):
+    y_true, y_pred = _flatten(y_true, y_pred)
+    return float(np.mean(np.abs(y_pred - y_true)))
+
+
+def mse(y_true, y_pred):
+    y_true, y_pred = _flatten(y_true, y_pred)
+    return float(np.mean((y_pred - y_true) ** 2))
+
+
+def rmse(y_true, y_pred):
+    return float(np.sqrt(mse(y_true, y_pred)))
+
+
+def msle(y_true, y_pred):
+    y_true, y_pred = _flatten(y_true, y_pred)
+    if (y_true < 0).any() or (y_pred < 0).any():
+        raise ValueError("msle needs non-negative values")
+    return float(np.mean((np.log1p(y_pred) - np.log1p(y_true)) ** 2))
+
+
+def r2(y_true, y_pred):
+    y_true, y_pred = _flatten(y_true, y_pred)
+    ss_res = np.sum((y_true - y_pred) ** 2)
+    ss_tot = np.sum((y_true - np.mean(y_true)) ** 2)
+    return float(1.0 - ss_res / (ss_tot + EPSILON))
+
+
+def mpe(y_true, y_pred):
+    y_true, y_pred = _flatten(y_true, y_pred)
+    return float(np.mean((y_pred - y_true) / (y_true + EPSILON)) * 100)
+
+
+def mape(y_true, y_pred):
+    y_true, y_pred = _flatten(y_true, y_pred)
+    return float(np.mean(np.abs((y_pred - y_true) /
+                                (y_true + EPSILON))) * 100)
+
+
+def smape(y_true, y_pred):
+    y_true, y_pred = _flatten(y_true, y_pred)
+    denom = (np.abs(y_true) + np.abs(y_pred)) / 2 + EPSILON
+    return float(np.mean(np.abs(y_pred - y_true) / denom) * 100)
+
+
+_METRICS = {
+    "me": me, "mae": mae, "mse": mse, "rmse": rmse, "msle": msle,
+    "r2": r2, "mpe": mpe, "mape": mape, "smape": smape,
+}
+
+# metrics where larger is better (everything else minimizes)
+MAXIMIZE = {"r2"}
+
+
+def evaluate(metric: str, y_true, y_pred) -> float:
+    name = metric.lower()
+    if name not in _METRICS:
+        raise ValueError(f"unknown metric {metric!r}; "
+                         f"have {sorted(_METRICS)}")
+    return _METRICS[name](y_true, y_pred)
+
+
+def evaluate_all(metrics: Sequence[str], y_true, y_pred
+                 ) -> Dict[str, float]:
+    return {m: evaluate(m, y_true, y_pred) for m in metrics}
+
+
+def mode_of(metric: str) -> str:
+    """'max' if larger is better for this metric, else 'min'."""
+    return "max" if metric.lower() in MAXIMIZE else "min"

@@ -1,0 +1,123 @@
+"""TimeSequencePipeline: fitted feature transformer + model as one unit.
+
+The analog of ``TimeSequencePipeline`` (ref: pyzoo/zoo/automl/pipeline/
+time_sequence.py:26-222 -- describe/fit/evaluate/predict/
+predict_with_uncertainty/save + load_ts_pipeline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.automl import metrics as automl_metrics
+from analytics_zoo_tpu.automl.feature import TimeSequenceFeatureTransformer
+from analytics_zoo_tpu.automl.models import TimeSequenceModel
+from analytics_zoo_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class TimeSequencePipeline:
+    def __init__(self, feature_transformers: TimeSequenceFeatureTransformer,
+                 model: TimeSequenceModel,
+                 config: Optional[Dict[str, Any]] = None,
+                 name: str = "ts_pipeline"):
+        self.feature_transformers = feature_transformers
+        self.model = model
+        self.config = dict(config or {})
+        self.name = name
+
+    def describe(self) -> Dict[str, Any]:
+        show = ("model", "past_seq_len", "selected_features", "lr",
+                "batch_size", "epochs")
+        return {k: self.config[k] for k in show if k in self.config}
+
+    # ------------------------------------------------------------- fit --
+    def fit(self, input_df: pd.DataFrame,
+            validation_df: Optional[pd.DataFrame] = None,
+            epoch_num: int = 20) -> "TimeSequencePipeline":
+        """Incremental training with the already-found config
+        (ref: time_sequence.py fit)."""
+        ft = self.feature_transformers
+        x, y = ft.transform(input_df, is_train=True)
+        val = None
+        if validation_df is not None:
+            val = ft.transform(validation_df, is_train=True)
+        from analytics_zoo_tpu.automl.predictor import _unscaler
+
+        config = dict(self.config)
+        config["epochs"] = epoch_num
+        reward = self.model.fit_eval(x, y, validation_data=val,
+                                     unscale_fn=_unscaler(ft), **config)
+        logger.info("pipeline fit: %s=%.6g",
+                    config.get("metric", "mse"), reward)
+        return self
+
+    def fit_with_fixed_configs(self, input_df: pd.DataFrame,
+                               validation_df: Optional[pd.DataFrame] = None,
+                               **user_configs) -> "TimeSequencePipeline":
+        """Fit from scratch with explicit configs (ref: time_sequence.py
+        fit_with_fixed_configs)."""
+        config = {**self.config, **user_configs}
+        ft = self.feature_transformers
+        x, y = ft.fit_transform(input_df, **config)
+        val = None
+        if validation_df is not None:
+            val = ft.transform(validation_df, is_train=True)
+        self.model.fit_eval(x, y, validation_data=val, **config)
+        self.config = config
+        return self
+
+    # ------------------------------------------------------- inference --
+    def predict(self, input_df: pd.DataFrame) -> pd.DataFrame:
+        ft = self.feature_transformers
+        x = ft.transform(input_df, is_train=False)
+        y_pred = self.model.predict(x)
+        return ft.post_processing(input_df, y_pred, is_train=False)
+
+    def predict_with_uncertainty(self, input_df: pd.DataFrame,
+                                 n_iter: int = 10):
+        ft = self.feature_transformers
+        x = ft.transform(input_df, is_train=False)
+        mean, std = self.model.predict_with_uncertainty(x, n_iter)
+        pred_df = ft.post_processing(input_df, mean, is_train=False)
+        t = len(ft.target_col)
+        std = std.reshape(len(std), ft.future_seq_len, t)
+        return pred_df, ft.unscale_uncertainty(std)
+
+    def evaluate(self, input_df: pd.DataFrame,
+                 metrics: List[str] = ("mse",)) -> Dict[str, float]:
+        ft = self.feature_transformers
+        x, _ = ft.transform(input_df, is_train=True)
+        y_pred = self.model.predict(x)
+        y_pred_unscaled, y_true = ft.post_processing(input_df, y_pred,
+                                                     is_train=True)
+        return automl_metrics.evaluate_all(metrics, y_true,
+                                           y_pred_unscaled)
+
+    # ----------------------------------------------------- persistence --
+    def save(self, dir_path: str) -> None:
+        os.makedirs(dir_path, exist_ok=True)
+        self.feature_transformers.save(dir_path)
+        self.model.save(os.path.join(dir_path, "model"))
+        from analytics_zoo_tpu.automl.feature import _jsonable
+
+        with open(os.path.join(dir_path, "pipeline.json"), "w") as f:
+            json.dump({"name": self.name,
+                       "config": _jsonable(self.config)}, f)
+        logger.info("pipeline saved to %s", dir_path)
+
+
+def load_ts_pipeline(dir_path: str) -> TimeSequencePipeline:
+    """(ref: time_sequence.py load_ts_pipeline)."""
+    with open(os.path.join(dir_path, "pipeline.json")) as f:
+        meta = json.load(f)
+    ft = TimeSequenceFeatureTransformer.restore(dir_path)
+    model = TimeSequenceModel.restore(os.path.join(dir_path, "model"))
+    return TimeSequencePipeline(ft, model, config=meta["config"],
+                                name=meta["name"])

@@ -1,0 +1,127 @@
+"""TimeSequencePredictor: fit(df) -> best TimeSequencePipeline.
+
+The analog of ``TimeSequencePredictor`` (ref: pyzoo/zoo/automl/
+regression/time_sequence_predictor.py:24-220 -- builds the feature
+transformer, compiles a recipe into the search engine, runs trials, and
+wraps the best config into a pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.automl.feature import TimeSequenceFeatureTransformer
+from analytics_zoo_tpu.automl.models import TimeSequenceModel
+from analytics_zoo_tpu.automl.pipeline import TimeSequencePipeline
+from analytics_zoo_tpu.automl.recipes import Recipe, SmokeRecipe
+from analytics_zoo_tpu.automl.search import SearchEngine
+from analytics_zoo_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def _unscaler(ft: TimeSequenceFeatureTransformer):
+    """[B, future*T] scaled -> data units (rewards must be comparable
+    with pipeline.evaluate, and ratio metrics need real units)."""
+    t = len(ft.target_col)
+
+    def unscale(arr):
+        arr = np.asarray(arr)
+        return ft._unscale_y(
+            arr.reshape(len(arr), ft.future_seq_len, t)
+        ).reshape(len(arr), -1)
+
+    return unscale
+
+
+def time_sequence_trial(config: Dict[str, Any],
+                        data: Dict[str, Any]) -> Dict[str, Any]:
+    """One search trial; top-level so it pickles to pool workers
+    (ref: ray_tune_search_engine.py train_func :282-346)."""
+    spec = data["spec"]
+    ft = TimeSequenceFeatureTransformer(**spec)
+    x, y = ft.fit_transform(data["train_df"], **config)
+    val = None
+    if data.get("validation_df") is not None:
+        val = ft.transform(data["validation_df"], is_train=True)
+    model = TimeSequenceModel(
+        future_seq_len=spec["future_seq_len"],
+        n_targets=len(ft.target_col))
+    reward = model.fit_eval(x, y, validation_data=val,
+                            unscale_fn=_unscaler(ft), **config)
+    return {"reward_metric": reward, "state": model.state_bytes(),
+            "example_x": x[:1]}
+
+
+class TimeSequencePredictor:
+    def __init__(self, name: str = "automl",
+                 logs_dir: Optional[str] = None, future_seq_len: int = 1,
+                 dt_col: str = "datetime", target_col="value",
+                 extra_features_col=None, drop_missing: bool = True,
+                 executor: str = "sequential",
+                 max_workers: Optional[int] = None):
+        self.name = name
+        self.logs_dir = logs_dir
+        self.future_seq_len = future_seq_len
+        self.dt_col = dt_col
+        self.target_col = ([target_col] if isinstance(target_col, str)
+                           else list(target_col))
+        self.extra_features_col = extra_features_col
+        self.drop_missing = drop_missing
+        self.executor = executor
+        self.max_workers = max_workers
+        self.pipeline: Optional[TimeSequencePipeline] = None
+
+    def _spec(self) -> Dict[str, Any]:
+        return {"future_seq_len": self.future_seq_len,
+                "dt_col": self.dt_col, "target_col": self.target_col,
+                "extra_features_col": self.extra_features_col,
+                "drop_missing": self.drop_missing}
+
+    def fit(self, input_df: pd.DataFrame,
+            validation_df: Optional[pd.DataFrame] = None,
+            recipe: Recipe = None, metric: str = "mse",
+            seed: int = 0) -> TimeSequencePipeline:
+        """Search over the recipe space; returns the best pipeline
+        (ref: time_sequence_predictor.py fit)."""
+        recipe = recipe or SmokeRecipe()
+        probe_ft = TimeSequenceFeatureTransformer(**self._spec())
+        feature_list = probe_ft.get_feature_list(input_df)
+
+        engine = SearchEngine(executor=self.executor,
+                              max_workers=self.max_workers,
+                              logs_dir=self.logs_dir, name=self.name)
+        data = {"spec": self._spec(), "train_df": input_df,
+                "validation_df": validation_df}
+        engine.compile(data, time_sequence_trial, recipe=recipe,
+                       feature_list=feature_list, metric=metric,
+                       seed=seed)
+        best = engine.run()
+        logger.info("best config: %s (%s=%.6g)", best.config, metric,
+                    best.reward)
+
+        # rebuild the winner in this process from its serialized weights
+        ft = TimeSequenceFeatureTransformer(**self._spec())
+        x, _ = ft.fit_transform(input_df, **best.config)
+        model = TimeSequenceModel(future_seq_len=self.future_seq_len,
+                                  n_targets=len(ft.target_col))
+        model.load_state_bytes(best.state, best.config, x[:1])
+        self.pipeline = TimeSequencePipeline(ft, model,
+                                             config=best.config,
+                                             name=self.name)
+        return self.pipeline
+
+    def evaluate(self, input_df, metrics=("mse",)):
+        self._need_fit()
+        return self.pipeline.evaluate(input_df, metrics)
+
+    def predict(self, input_df):
+        self._need_fit()
+        return self.pipeline.predict(input_df)
+
+    def _need_fit(self):
+        if self.pipeline is None:
+            raise RuntimeError("call fit() first")

@@ -1,0 +1,175 @@
+"""Search-space recipes.
+
+The analog of the reference recipe set (ref: pyzoo/zoo/automl/config/
+recipe.py:620 -- SmokeRecipe, GridRandomRecipe, LSTMGridRandomRecipe,
+MTNetGridRandomRecipe...), rewritten against :mod:`space` samplers. A
+recipe = a search space over (features, model hyperparameters, training
+params) + runtime parameters (num_samples per grid point, epochs per
+trial).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from analytics_zoo_tpu.automl.space import (Choice, FeatureSubset, Grid,
+                                            SampleFrom, Uniform)
+
+
+class Recipe:
+    """(ref: recipe.py Recipe)."""
+
+    def __init__(self):
+        self.training_iteration = 1
+        self.num_samples = 1
+        self.reward_metric = None
+
+    def search_space(self, all_available_features: List[str]
+                     ) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def runtime_params(self) -> Dict[str, Any]:
+        out = {"training_iteration": self.training_iteration,
+               "num_samples": self.num_samples}
+        if self.reward_metric is not None:
+            out["reward_metric"] = self.reward_metric
+        return out
+
+
+class SmokeRecipe(Recipe):
+    """One random LSTM config, one epoch (ref: recipe.py SmokeRecipe)."""
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": list(all_available_features),
+            "model": "LSTM",
+            "lstm_1_units": Choice([32, 64]),
+            "dropout_1": Uniform(0.2, 0.5),
+            "lstm_2_units": Choice([32, 64]),
+            "dropout_2": Uniform(0.2, 0.5),
+            "lr": 0.001,
+            "batch_size": 64,
+            "epochs": 1,
+            "past_seq_len": 2,
+        }
+
+
+class GridRandomRecipe(Recipe):
+    """Random feature subsets x a small LSTM grid
+    (ref: recipe.py GridRandomRecipe)."""
+
+    def __init__(self, num_rand_samples: int = 1, look_back: int = 2):
+        super().__init__()
+        self.num_samples = num_rand_samples
+        self.look_back = look_back
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": FeatureSubset(all_available_features),
+            "model": "LSTM",
+            "lstm_1_units": Grid([16, 32]),
+            "dropout_1": Uniform(0.2, 0.5),
+            "lstm_2_units": Grid([16, 32]),
+            "dropout_2": Uniform(0.2, 0.5),
+            "lr": 0.001,
+            "batch_size": 64,
+            "epochs": 1,
+            "past_seq_len": self.look_back,
+        }
+
+
+class LSTMGridRandomRecipe(GridRandomRecipe):
+    """(ref: recipe.py LSTMGridRandomRecipe -- wider LSTM grid)."""
+
+    def __init__(self, num_rand_samples: int = 1, look_back: int = 2,
+                 lstm_1_units=(16, 32, 64), lstm_2_units=(16, 32, 64),
+                 batch_size=(32, 64)):
+        super().__init__(num_rand_samples, look_back)
+        self.lstm_1_units = list(lstm_1_units)
+        self.lstm_2_units = list(lstm_2_units)
+        self.batch_size = list(batch_size)
+
+    def search_space(self, all_available_features):
+        s = super().search_space(all_available_features)
+        s.update({
+            "lstm_1_units": Grid(self.lstm_1_units),
+            "lstm_2_units": Grid(self.lstm_2_units),
+            "batch_size": Choice(self.batch_size),
+        })
+        return s
+
+
+class Seq2SeqRandomRecipe(Recipe):
+    def __init__(self, num_rand_samples: int = 1, look_back: int = 8):
+        super().__init__()
+        self.num_samples = num_rand_samples
+        self.look_back = look_back
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": FeatureSubset(all_available_features),
+            "model": "Seq2Seq",
+            "latent_dim": Choice([32, 64, 128]),
+            "dropout": Uniform(0.1, 0.4),
+            "lr": 0.001,
+            "batch_size": 64,
+            "epochs": 1,
+            "past_seq_len": self.look_back,
+        }
+
+
+class MTNetGridRandomRecipe(Recipe):
+    """(ref: recipe.py MTNetGridRandomRecipe -- past_seq_len depends on
+    the sampled long_num and time_step)."""
+
+    def __init__(self, num_rand_samples: int = 1,
+                 time_step=(3, 4), long_num=(3, 4), ar_size=(2, 3),
+                 cnn_height=(2, 3), cnn_hidden=(32,), rnn_hidden=(32,)):
+        super().__init__()
+        self.num_samples = num_rand_samples
+        self.time_step = list(time_step)
+        self.long_num = list(long_num)
+        self.ar_size = list(ar_size)
+        self.cnn_height = list(cnn_height)
+        self.cnn_hidden = list(cnn_hidden)
+        self.rnn_hidden = list(rnn_hidden)
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": FeatureSubset(all_available_features),
+            "model": "MTNet",
+            "time_step": Choice(self.time_step),
+            "long_num": Choice(self.long_num),
+            "ar_size": Choice(self.ar_size),
+            "cnn_height": Choice(self.cnn_height),
+            "cnn_hidden": Choice(self.cnn_hidden),
+            "rnn_hidden": Choice(self.rnn_hidden),
+            "cnn_dropout": Uniform(0.1, 0.3),
+            "rnn_dropout": Uniform(0.1, 0.3),
+            "lr": 0.001,
+            "batch_size": 64,
+            "epochs": 1,
+            "past_seq_len": SampleFrom(
+                lambda c: (c["long_num"] + 1) * c["time_step"]),
+        }
+
+
+class TCNGridRandomRecipe(Recipe):
+    def __init__(self, num_rand_samples: int = 1, look_back: int = 16):
+        super().__init__()
+        self.num_samples = num_rand_samples
+        self.look_back = look_back
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": FeatureSubset(all_available_features),
+            "model": "TCN",
+            "levels": Choice([2, 3]),
+            "hidden": Choice([16, 30]),
+            "kernel_size": Choice([2, 3]),
+            "dropout": Uniform(0.05, 0.25),
+            "lr": 0.001,
+            "batch_size": 64,
+            "epochs": 1,
+            "past_seq_len": self.look_back,
+        }

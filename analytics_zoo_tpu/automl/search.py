@@ -1,0 +1,197 @@
+"""Hyperparameter search engine over a local process pool.
+
+The analog of ``RayTuneSearchEngine`` (ref: pyzoo/zoo/automl/search/
+ray_tune_search_engine.py:32-471 -- tune.run over a Trainable that
+fit_evals a model per sampled config). The TPU redesign schedules trials
+itself: configs come from :mod:`space` expansion, each trial runs a
+picklable ``trial_fn(config, data) -> {"reward_metric", "state"}`` either
+in-process (``executor="sequential"``) or on a spawn-context process pool
+(``executor="process"``). Trial processes are pinned to the CPU backend
+via JAX_PLATFORMS so a fleet of small searches never contends for the
+TPU chip -- the chip belongs to the final refit/serving path.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from analytics_zoo_tpu.automl import metrics as automl_metrics
+from analytics_zoo_tpu.automl.space import expand_and_sample
+from analytics_zoo_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class TrialOutput:
+    """(ref: search/abstract.py TrialOutput)."""
+
+    config: Dict[str, Any]
+    reward: Optional[float] = None
+    state: Optional[bytes] = None
+    error: Optional[str] = None
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+
+_WORKER_DATA = None  # per-pool-worker dataset, set once by initializer
+
+
+def _trial_entry(trial_fn, config, data):
+    """Top-level so it pickles under the spawn start method. ``data`` is
+    the sentinel ``_FROM_WORKER`` in pool workers (the dataset shipped
+    once via the initializer, not re-pickled per trial)."""
+    if data is _FROM_WORKER:
+        data = _WORKER_DATA
+    try:
+        result = trial_fn(config, data)
+        return TrialOutput(config=config,
+                           reward=float(result["reward_metric"]),
+                           state=result.get("state"),
+                           extras={k: v for k, v in result.items()
+                                   if k not in ("reward_metric", "state")})
+    except Exception as e:  # a failed trial must not sink the search
+        import traceback
+
+        return TrialOutput(config=config,
+                           error=f"{e}\n{traceback.format_exc()}")
+
+
+class _FromWorker:
+    def __reduce__(self):
+        return (_get_sentinel, ())
+
+
+def _get_sentinel():
+    return _FROM_WORKER
+
+
+_FROM_WORKER = _FromWorker()
+
+
+def _init_cpu_worker(data=None):
+    # trials run on host CPU; never grab the TPU from a pool worker
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    global _WORKER_DATA
+    _WORKER_DATA = data
+
+
+class SearchEngine:
+    """compile() -> run() -> get_best_trials(k).
+
+    Args:
+      executor: "sequential" (in-process) or "process" (spawn pool).
+      max_workers: pool width for the process executor.
+      logs_dir: when set, each trial's reward lands in a TensorBoard
+        event file (ref: automl/logger/tensorboardxlogger.py).
+    """
+
+    def __init__(self, executor: str = "sequential",
+                 max_workers: Optional[int] = None,
+                 logs_dir: Optional[str] = None, name: str = "automl"):
+        if executor not in ("sequential", "process"):
+            raise ValueError("executor must be sequential|process")
+        self.executor = executor
+        self.max_workers = max_workers or min(4, os.cpu_count() or 1)
+        self.logs_dir = logs_dir
+        self.name = name
+        self.trial_fn: Optional[Callable] = None
+        self.data: Any = None
+        self.configs: List[Dict[str, Any]] = []
+        self.metric = "mse"
+        self.mode = "min"
+        self.trials: List[TrialOutput] = []
+
+    # ----------------------------------------------------------- setup --
+    def compile(self, data: Any, trial_fn: Callable, recipe=None,
+                search_space: Optional[Dict[str, Any]] = None,
+                feature_list: Optional[List[str]] = None,
+                metric: str = "mse", seed: int = 0) -> None:
+        """Freeze the trial plan (ref: RayTuneSearchEngine.compile).
+
+        ``recipe`` supplies search_space(feature_list) + runtime params;
+        alternatively pass an explicit ``search_space`` dict.
+        """
+        self.data = data
+        self.trial_fn = trial_fn
+        self.metric = metric
+        self.mode = automl_metrics.mode_of(metric)
+        num_samples = 1
+        if recipe is not None:
+            search_space = recipe.search_space(feature_list or [])
+            runtime = recipe.runtime_params()
+            num_samples = int(runtime.get("num_samples", 1))
+            iters = int(runtime.get("training_iteration", 1))
+            # reference semantics: tune reruns the trainable
+            # training_iteration times, each pass training the space's
+            # `epochs`; the flat total is epochs * training_iteration
+            search_space["epochs"] = (
+                int(search_space.get("epochs", 1)) * iters)
+        if search_space is None:
+            raise ValueError("need recipe or search_space")
+        search_space.setdefault("metric", metric)
+        self.configs = expand_and_sample(search_space,
+                                         num_samples=num_samples,
+                                         seed=seed)
+        logger.info("search compiled: %d trials", len(self.configs))
+
+    # ------------------------------------------------------------- run --
+    def run(self) -> TrialOutput:
+        if self.trial_fn is None:
+            raise RuntimeError("compile() first")
+        if self.executor == "process" and len(self.configs) > 1:
+            self.trials = self._run_pool()
+        else:
+            self.trials = [_trial_entry(self.trial_fn, c, self.data)
+                           for c in self.configs]
+        self._log_trials()
+        ok = [t for t in self.trials if t.error is None]
+        if not ok:
+            errors = "; ".join((t.error or "").splitlines()[0]
+                               for t in self.trials[:3])
+            raise RuntimeError(f"all {len(self.trials)} trials failed: "
+                               f"{errors}")
+        return self.get_best_trials(1)[0]
+
+    def _run_pool(self) -> List[TrialOutput]:
+        import multiprocessing as mp
+        from concurrent.futures import ProcessPoolExecutor
+
+        ctx = mp.get_context("spawn")
+        with ProcessPoolExecutor(max_workers=self.max_workers,
+                                 mp_context=ctx,
+                                 initializer=_init_cpu_worker,
+                                 initargs=(self.data,)) as pool:
+            # dataset ships once per worker via the initializer; each
+            # submit carries only the config + the sentinel
+            futures = [pool.submit(_trial_entry, self.trial_fn, c,
+                                   _FROM_WORKER)
+                       for c in self.configs]
+            return [f.result() for f in futures]
+
+    def _log_trials(self) -> None:
+        for i, t in enumerate(self.trials):
+            if t.error is not None:
+                logger.warning("trial %d failed: %s", i,
+                               t.error.splitlines()[0])
+            else:
+                logger.info("trial %d: %s=%.6g", i, self.metric, t.reward)
+        if self.logs_dir:
+            from analytics_zoo_tpu.utils.summary import SummaryWriter
+
+            writer = SummaryWriter(os.path.join(self.logs_dir, self.name))
+            try:
+                for i, t in enumerate(self.trials):
+                    if t.error is None:
+                        writer.add_scalar(f"search/{self.metric}",
+                                          t.reward, i)
+            finally:
+                writer.close()
+
+    def get_best_trials(self, k: int = 1) -> List[TrialOutput]:
+        """(ref: RayTuneSearchEngine.get_best_trials)."""
+        ok = [t for t in self.trials if t.error is None]
+        reverse = self.mode == "max"
+        return sorted(ok, key=lambda t: t.reward, reverse=reverse)[:k]

@@ -1,0 +1,133 @@
+"""Search-space primitives for hyperparameter search.
+
+The dependency-free analog of the ``ray.tune`` sampling API the reference
+recipes are written against (ref: pyzoo/zoo/automl/config/recipe.py --
+tune.choice / tune.uniform / tune.grid_search / tune.sample_from).
+A space is a plain dict whose values are either literals or the sampler
+objects below; ``expand_and_sample`` turns it into concrete trial
+configs: grid axes expand cartesian-product style, random axes draw
+``num_samples`` times per grid point, and ``SampleFrom`` values resolve
+last against the partially-built config (dependent parameters, e.g.
+MTNet's past_seq_len = (long_num + 1) * time_step).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Sequence
+
+import numpy as np
+
+
+class Sampler:
+    """Base: draws one value from the distribution."""
+
+    def sample(self, rng: np.random.RandomState) -> Any:
+        raise NotImplementedError
+
+
+class Choice(Sampler):
+    def __init__(self, options: Sequence[Any]):
+        self.options = list(options)
+
+    def sample(self, rng):
+        return self.options[rng.randint(len(self.options))]
+
+
+class Uniform(Sampler):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return float(rng.uniform(self.low, self.high))
+
+
+class QUniform(Sampler):
+    """Uniform quantized to multiples of ``q``."""
+
+    def __init__(self, low: float, high: float, q: float = 1.0):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        v = np.round(rng.uniform(self.low, self.high) / self.q) * self.q
+        v = float(np.clip(v, self.low, self.high))
+        return int(v) if float(self.q).is_integer() else v
+
+
+class LogUniform(Sampler):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return float(np.exp(rng.uniform(np.log(self.low),
+                                        np.log(self.high))))
+
+
+class RandInt(Sampler):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return int(rng.randint(self.low, self.high))
+
+
+class FeatureSubset(Sampler):
+    """A random subset of the available feature names (the reference's
+    GridRandomRecipe draws random feature combinations)."""
+
+    def __init__(self, features: Sequence[str], min_size: int = 0,
+                 max_size: int = None):
+        self.features = list(features)
+        self.min_size = min_size
+        self.max_size = (len(self.features) if max_size is None
+                         else max_size)
+
+    def sample(self, rng):
+        hi = min(self.max_size, len(self.features))
+        k = rng.randint(self.min_size, hi + 1)
+        idx = rng.choice(len(self.features), size=k, replace=False)
+        return [self.features[i] for i in sorted(idx)]
+
+
+class Grid:
+    """Exhaustive axis (ref: tune.grid_search)."""
+
+    def __init__(self, options: Sequence[Any]):
+        self.options = list(options)
+
+
+class SampleFrom:
+    """Computed parameter: ``fn(config) -> value`` resolved after every
+    sampled/grid parameter is in place (ref: tune.sample_from)."""
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], Any]):
+        self.fn = fn
+
+
+def expand_and_sample(space: Dict[str, Any], num_samples: int = 1,
+                      seed: int = 0) -> List[Dict[str, Any]]:
+    """Space dict -> list of concrete configs.
+
+    total trials = (product of Grid axis sizes) * num_samples.
+    """
+    rng = np.random.RandomState(seed)
+    grid_keys = [k for k, v in space.items() if isinstance(v, Grid)]
+    grid_values = [space[k].options for k in grid_keys]
+    configs: List[Dict[str, Any]] = []
+    for point in itertools.product(*grid_values) if grid_keys else [()]:
+        for _ in range(num_samples):
+            config: Dict[str, Any] = dict(zip(grid_keys, point))
+            deferred = {}
+            for k, v in space.items():
+                if isinstance(v, Grid):
+                    continue
+                if isinstance(v, SampleFrom):
+                    deferred[k] = v
+                elif isinstance(v, Sampler):
+                    config[k] = v.sample(rng)
+                else:
+                    config[k] = v
+            for k, v in deferred.items():
+                config[k] = v.fn(config)
+            configs.append(config)
+    return configs

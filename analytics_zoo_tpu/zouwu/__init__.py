@@ -1,0 +1,23 @@
+"""Zouwu: the time-series toolkit (AutoTS, forecasters, anomaly).
+
+The analog of the reference's zouwu subsystem (ref: pyzoo/zoo/zouwu --
+AutoTSTrainer/TSPipeline over automl, standalone LSTM/MTNet/TCMF
+forecasters, threshold anomaly detection; SURVEY.md section 2.2).
+"""
+
+from analytics_zoo_tpu.zouwu.anomaly import (  # noqa: F401
+    ThresholdDetector,
+    ThresholdEstimator,
+)
+from analytics_zoo_tpu.zouwu.autots import (  # noqa: F401
+    AutoTSTrainer,
+    TSPipeline,
+)
+from analytics_zoo_tpu.zouwu.forecast import (  # noqa: F401
+    Forecaster,
+    LSTMForecaster,
+    MTNetForecaster,
+    Seq2SeqForecaster,
+    TCMFForecaster,
+    TCNForecaster,
+)

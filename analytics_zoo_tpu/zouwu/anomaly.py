@@ -1,0 +1,96 @@
+"""Threshold-based anomaly detection for time series.
+
+The analog of zouwu anomaly detection (ref: pyzoo/zoo/zouwu/model/
+anomaly.py:51-130 -- ThresholdEstimator fits a threshold from forecast
+residuals, ThresholdDetector flags samples whose actual/predicted
+distance exceeds it, with scalar / per-sample / per-dimension / (min,max)
+range threshold forms).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+
+def euclidean_distance(y: np.ndarray, yhat: np.ndarray) -> np.ndarray:
+    """Per-sample L2 distance; samples along axis 0."""
+    d = (np.asarray(y, np.float64) -
+         np.asarray(yhat, np.float64)).reshape(len(y), -1)
+    return np.linalg.norm(d, axis=1)
+
+
+class ThresholdEstimator:
+    """Pick a distance threshold from residuals
+    (ref: anomaly.py ThresholdEstimator.fit)."""
+
+    def fit(self, y: np.ndarray, yhat: np.ndarray,
+            mode: str = "default", ratio: float = 0.01) -> float:
+        y, yhat = np.asarray(y), np.asarray(yhat)
+        if y.shape != yhat.shape:
+            raise ValueError("y and yhat must share a shape")
+        dist = euclidean_distance(y, yhat)
+        if mode == "default":  # empirical quantile
+            return float(np.percentile(dist, (1 - ratio) * 100))
+        if mode == "gaussian":  # fit N(mu, sigma), take the 1-ratio ppf
+            mu, sigma = float(dist.mean()), float(dist.std())
+            # inverse CDF via erfinv, no scipy dependency
+            from math import sqrt
+
+            t = sqrt(2) * _erfinv(2 * (1 - ratio) - 1)
+            return t * sigma + mu
+        raise ValueError(f"unsupported mode {mode!r}")
+
+
+def _erfinv(x: float) -> float:
+    """Winitzki's approximation; |error| < 5e-3 over (-1, 1), plenty for
+    picking an anomaly quantile."""
+    a = 0.147
+    ln1mx2 = math.log(1 - x * x)
+    term = 2 / (math.pi * a) + ln1mx2 / 2
+    return math.copysign(
+        math.sqrt(math.sqrt(term ** 2 - ln1mx2 / a) - term), x)
+
+
+class ThresholdDetector:
+    """(ref: anomaly.py ThresholdDetector.detect). Threshold forms:
+
+    - scalar: one distance bound for every sample;
+    - [num_samples] array: per-sample distance bound;
+    - array shaped like y: per-dimension distance bound;
+    - (min, max) tuple of arrays/scalars: y outside the range is
+      anomalous, yhat is ignored.
+
+    Returns the indices of anomalous samples (axis-0 positions).
+    """
+
+    def detect(self, y: np.ndarray, yhat: Optional[np.ndarray] = None,
+               threshold: Union[float, np.ndarray, Tuple] = math.inf
+               ) -> np.ndarray:
+        y = np.asarray(y)
+        if isinstance(threshold, tuple):
+            lo, hi = (np.asarray(t, np.float64) for t in threshold)
+            if np.any(lo > hi):
+                raise ValueError("threshold min exceeds max")
+            bad = (y < lo) | (y > hi)
+            return np.unique(np.nonzero(bad)[0])
+        if yhat is None:
+            raise ValueError("yhat is required for distance thresholds")
+        yhat = np.asarray(yhat)
+        if y.shape != yhat.shape:
+            raise ValueError("y and yhat must share a shape")
+        threshold = np.asarray(threshold, np.float64)
+        if threshold.ndim == 0:
+            dist = euclidean_distance(y, yhat)
+            return np.nonzero(dist > float(threshold))[0]
+        if threshold.ndim == 1:
+            if len(threshold) != len(y):
+                raise ValueError("per-sample threshold length mismatch")
+            dist = euclidean_distance(y, yhat)
+            return np.nonzero(dist > threshold)[0]
+        if threshold.shape != y.shape:
+            raise ValueError("per-dimension threshold shape mismatch")
+        bad = np.abs(y.astype(np.float64) - yhat) > threshold
+        return np.unique(np.nonzero(bad)[0])

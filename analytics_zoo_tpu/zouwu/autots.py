@@ -1,0 +1,73 @@
+"""AutoTS: automated time-series forecasting.
+
+The analog of zouwu AutoTS (ref: pyzoo/zoo/zouwu/autots/forecast.py:
+22-140 -- AutoTSTrainer wraps TimeSequencePredictor, TSPipeline wraps
+the fitted TimeSequencePipeline).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import pandas as pd
+
+from analytics_zoo_tpu.automl.pipeline import (TimeSequencePipeline,
+                                               load_ts_pipeline)
+from analytics_zoo_tpu.automl.predictor import TimeSequencePredictor
+from analytics_zoo_tpu.automl.recipes import Recipe, SmokeRecipe
+
+
+class TSPipeline:
+    """Fitted forecasting pipeline (ref: forecast.py TSPipeline)."""
+
+    def __init__(self, internal: Optional[TimeSequencePipeline] = None):
+        self.internal = internal
+
+    def fit(self, input_df: pd.DataFrame,
+            validation_df: Optional[pd.DataFrame] = None,
+            epoch_num: int = 20) -> "TSPipeline":
+        self.internal.fit(input_df, validation_df, epoch_num=epoch_num)
+        return self
+
+    def predict(self, input_df: pd.DataFrame) -> pd.DataFrame:
+        return self.internal.predict(input_df)
+
+    def predict_with_uncertainty(self, input_df: pd.DataFrame,
+                                 n_iter: int = 10):
+        return self.internal.predict_with_uncertainty(input_df, n_iter)
+
+    def evaluate(self, input_df: pd.DataFrame,
+                 metrics: List[str] = ("mse",)):
+        return self.internal.evaluate(input_df, metrics)
+
+    def describe(self):
+        return self.internal.describe()
+
+    def save(self, pipeline_dir: str) -> None:
+        self.internal.save(pipeline_dir)
+
+    @staticmethod
+    def load(pipeline_dir: str) -> "TSPipeline":
+        return TSPipeline(load_ts_pipeline(pipeline_dir))
+
+
+class AutoTSTrainer:
+    """(ref: forecast.py AutoTSTrainer)."""
+
+    def __init__(self, horizon: int = 1, dt_col: str = "datetime",
+                 target_col="value", extra_features_col=None,
+                 logs_dir: Optional[str] = None,
+                 executor: str = "sequential",
+                 max_workers: Optional[int] = None):
+        self.internal = TimeSequencePredictor(
+            dt_col=dt_col, target_col=target_col, future_seq_len=horizon,
+            extra_features_col=extra_features_col, logs_dir=logs_dir,
+            executor=executor, max_workers=max_workers)
+
+    def fit(self, train_df: pd.DataFrame,
+            validation_df: Optional[pd.DataFrame] = None,
+            metric: str = "mse", recipe: Recipe = None) -> TSPipeline:
+        pipeline = self.internal.fit(train_df, validation_df,
+                                     recipe=recipe or SmokeRecipe(),
+                                     metric=metric)
+        return TSPipeline(pipeline)

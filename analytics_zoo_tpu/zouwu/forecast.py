@@ -1,0 +1,279 @@
+"""Standalone forecasters: LSTM, MTNet, Seq2Seq, TCN, TCMF.
+
+The analog of the zouwu forecaster family (ref: pyzoo/zoo/zouwu/model/
+forecast/ -- lstm_forecaster.py, mtnet_forecaster.py:22-90,
+tcmf_forecaster.py). All but TCMF wrap one :class:`TimeSequenceModel`
+configuration behind a scikit-style fit/predict/evaluate surface. TCMF
+is the multi-series model: a low-rank factorization Y ~= F @ X with a
+TCN over the temporal factors, trained end-to-end by gradient descent
+(the TPU-native collapse of DeepGLO's alternating scheme, ref:
+automl/model/tcmf/DeepGLO.py:904 -- one jitted loss instead of
+interleaved torch loops).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.automl import metrics as automl_metrics
+from analytics_zoo_tpu.automl.models import TCN, TimeSequenceModel
+from analytics_zoo_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class Forecaster:
+    """Base (ref: forecast/abstract.py): subclasses define
+    ``_model_config()``; x is [B, past_seq_len, feature_dim]."""
+
+    def __init__(self, future_seq_len: int, n_targets: int = 1,
+                 feature_dim: Optional[int] = None):
+        self.model = TimeSequenceModel(future_seq_len=future_seq_len,
+                                       n_targets=n_targets)
+        self.feature_dim = feature_dim
+
+    def _model_config(self) -> Dict:
+        raise NotImplementedError
+
+    def fit(self, x: np.ndarray, y: np.ndarray,
+            validation_data: Optional[Tuple] = None, epochs: int = 1,
+            batch_size: int = 32, metric: str = "mse") -> float:
+        x = np.asarray(x, np.float32)
+        if self.feature_dim is not None and \
+                x.shape[-1] != self.feature_dim:
+            raise ValueError(
+                f"input has {x.shape[-1]} features, forecaster was "
+                f"declared with feature_dim={self.feature_dim}")
+        config = dict(self._model_config(), epochs=epochs,
+                      batch_size=batch_size, metric=metric)
+        y = np.asarray(y).reshape(len(y), -1)
+        return self.model.fit_eval(x, y,
+                                   validation_data=validation_data,
+                                   **config)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return self.model.predict(np.asarray(x, np.float32))
+
+    def predict_with_uncertainty(self, x: np.ndarray, n_iter: int = 10):
+        return self.model.predict_with_uncertainty(
+            np.asarray(x, np.float32), n_iter)
+
+    def evaluate(self, x: np.ndarray, y: np.ndarray,
+                 metrics: Sequence[str] = ("mse",)) -> Dict[str, float]:
+        return self.model.evaluate(np.asarray(x, np.float32), y, metrics)
+
+    def save(self, dir_path: str) -> None:
+        self.model.save(dir_path)
+
+    def restore(self, dir_path: str) -> None:
+        self.model = TimeSequenceModel.restore(dir_path)
+
+
+class LSTMForecaster(Forecaster):
+    """(ref: forecast/lstm_forecaster.py:20-80)."""
+
+    def __init__(self, target_dim: int = 1, feature_dim: int = None,
+                 lstm_1_units: int = 16, dropout_1: float = 0.2,
+                 lstm_2_units: int = 8, dropout_2: float = 0.2,
+                 lr: float = 0.001):
+        super().__init__(future_seq_len=target_dim, n_targets=1,
+                         feature_dim=feature_dim)
+        self._config = {
+            "model": "LSTM", "lstm_1_units": lstm_1_units,
+            "dropout_1": dropout_1, "lstm_2_units": lstm_2_units,
+            "dropout_2": dropout_2, "lr": lr,
+        }
+
+    def _model_config(self):
+        return dict(self._config)
+
+
+class Seq2SeqForecaster(Forecaster):
+    def __init__(self, horizon: int = 1, feature_dim: int = None,
+                 latent_dim: int = 64, dropout: float = 0.2,
+                 lr: float = 0.001):
+        super().__init__(future_seq_len=horizon, n_targets=1,
+                         feature_dim=feature_dim)
+        self._config = {"model": "Seq2Seq", "latent_dim": latent_dim,
+                        "dropout": dropout, "lr": lr}
+
+    def _model_config(self):
+        return dict(self._config)
+
+
+class TCNForecaster(Forecaster):
+    def __init__(self, horizon: int = 1, feature_dim: int = None,
+                 levels: int = 3, hidden: int = 30, kernel_size: int = 3,
+                 dropout: float = 0.1, lr: float = 0.001):
+        super().__init__(future_seq_len=horizon, n_targets=1,
+                         feature_dim=feature_dim)
+        self._config = {"model": "TCN", "levels": levels,
+                        "hidden": hidden, "kernel_size": kernel_size,
+                        "dropout": dropout, "lr": lr}
+
+    def _model_config(self):
+        return dict(self._config)
+
+
+class MTNetForecaster(Forecaster):
+    """(ref: forecast/mtnet_forecaster.py:22-90). The input window must
+    be ``(long_series_num + 1) * series_length`` steps long; use
+    :meth:`preprocess_input` to roll a raw series accordingly."""
+
+    def __init__(self, target_dim: int = 1, feature_dim: int = None,
+                 long_series_num: int = 1, series_length: int = 1,
+                 ar_window_size: int = 1, cnn_height: int = 1,
+                 cnn_hid_size: int = 32, rnn_hid_size: int = 32,
+                 cnn_dropout: float = 0.2, rnn_dropout: float = 0.2,
+                 lr: float = 0.001):
+        super().__init__(future_seq_len=1, n_targets=target_dim,
+                         feature_dim=feature_dim)
+        self.past_seq_len = (long_series_num + 1) * series_length
+        self._config = {
+            "model": "MTNet", "time_step": series_length,
+            "long_num": long_series_num, "ar_size": ar_window_size,
+            "cnn_height": cnn_height, "cnn_hidden": cnn_hid_size,
+            "rnn_hidden": rnn_hid_size, "cnn_dropout": cnn_dropout,
+            "rnn_dropout": rnn_dropout, "lr": lr,
+        }
+
+    def _model_config(self):
+        return dict(self._config)
+
+
+class TCMFForecaster:
+    """Temporal-convolution matrix factorization for forecasting MANY
+    correlated series at once (ref: forecast/tcmf_forecaster.py,
+    automl/model/tcmf/DeepGLO.py:904).
+
+    Y [n_series, T] ~= F [n_series, rank] @ X [rank, T]; a TCN over X's
+    rows learns the temporal dynamics and rolls X beyond T at predict
+    time. Both the factors and the TCN train jointly under one jitted
+    Adam loop: reconstruction loss + one-step-ahead forecast loss on X.
+    """
+
+    def __init__(self, rank: int = 8, tcn_levels: int = 3,
+                 tcn_hidden: int = 32, kernel_size: int = 3,
+                 window: int = 16, lr: float = 0.01, seed: int = 0):
+        self.rank = rank
+        self.window = window
+        self.lr = lr
+        self.seed = seed
+        self.tcn = TCN(levels=tcn_levels, hidden=tcn_hidden,
+                       kernel_size=kernel_size, dropout=0.0,
+                       output_dim=rank)
+        self.params = None
+        self.y_mean = None
+        self.y_std = None
+        self._x_factors = None
+
+    def fit(self, y: np.ndarray, epochs: int = 100) -> Dict[str, float]:
+        """y: [n_series, T]. Returns final losses."""
+        import optax
+
+        y = np.asarray(y, np.float32)
+        if y.ndim != 2:
+            raise ValueError("TCMF wants y shaped [n_series, T]")
+        n, t = y.shape
+        if t <= self.window + 1:
+            raise ValueError("series shorter than the TCN window")
+        self.y_mean = y.mean(axis=1, keepdims=True)
+        self.y_std = np.where(y.std(axis=1, keepdims=True) < 1e-8, 1.0,
+                              y.std(axis=1, keepdims=True))
+        yn = jnp.asarray((y - self.y_mean) / self.y_std)
+
+        rng = jax.random.PRNGKey(self.seed)
+        k_f, k_x, k_t = jax.random.split(rng, 3)
+        scale = 1.0 / np.sqrt(self.rank)
+        params = {
+            "F": jax.random.normal(k_f, (n, self.rank)) * scale,
+            "X": jax.random.normal(k_x, (self.rank, t)) * scale,
+            "tcn": self.tcn.init(
+                k_t, jnp.zeros((1, self.window, self.rank)))["params"],
+            # per-factor linear AR coefficients over the window: linear
+            # recurrences extrapolate smooth/periodic factors exactly,
+            # the TCN learns the nonlinear residual
+            "ar": jnp.zeros((self.rank, self.window)),
+        }
+        tx = optax.adam(self.lr)
+        opt_state = tx.init(params)
+        window, tcn = self.window, self.tcn
+        rollout = min(4, t - window)
+
+        def loss_fn(p):
+            recon = p["F"] @ p["X"]
+            recon_loss = jnp.mean((recon - yn) ** 2)
+            xt = p["X"].T  # [T, rank]
+            # temporal smoothness keeps the factors predictable -- the
+            # TCN must learn dynamics, not memorize a jagged sequence
+            smooth_loss = jnp.mean((xt[1:] - xt[:-1]) ** 2)
+            # multi-step rollout forecast loss: from every window, roll
+            # ``rollout`` steps feeding predictions back in -- predict()
+            # uses the model exactly this way, so one-step teacher
+            # forcing alone would let the TCN memorize the sequence and
+            # diverge off the end of the training range
+            starts = jnp.arange(t - window - rollout + 1)
+            wins = jax.vmap(
+                lambda s: jax.lax.dynamic_slice(
+                    xt, (s, 0), (window, xt.shape[1])))(starts)
+            targets = jax.vmap(
+                lambda s: jax.lax.dynamic_slice(
+                    xt, (s + window, 0), (rollout, xt.shape[1])))(starts)
+
+            def roll_step(w, _):
+                # w: [B, window, rank]; AR term + TCN residual
+                ar = jnp.einsum("bwk,kw->bk", w, p["ar"])
+                nxt = ar + tcn.apply({"params": p["tcn"]}, w)
+                w = jnp.concatenate([w[:, 1:], nxt[:, None]], axis=1)
+                return w, nxt
+
+            _, preds = jax.lax.scan(roll_step, wins, None, length=rollout)
+            fore_loss = jnp.mean(
+                (jnp.moveaxis(preds, 0, 1) - targets) ** 2)
+            loss = recon_loss + fore_loss + 0.1 * smooth_loss
+            return loss, (recon_loss, fore_loss)
+
+        @jax.jit
+        def step(p, s):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p)
+            updates, s = tx.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, loss, aux
+
+        loss = recon = fore = None
+        for i in range(epochs):
+            params, opt_state, loss, (recon, fore) = step(params,
+                                                          opt_state)
+        self.params = jax.device_get(params)
+        self._x_factors = self.params["X"]
+        logger.info("TCMF fit: loss=%.5f recon=%.5f forecast=%.5f",
+                    float(loss), float(recon), float(fore))
+        return {"loss": float(loss), "recon": float(recon),
+                "forecast": float(fore)}
+
+    def predict(self, horizon: int = 1) -> np.ndarray:
+        """Roll X forward ``horizon`` steps, project through F."""
+        if self.params is None:
+            raise RuntimeError("fit first")
+        xt = jnp.asarray(self.params["X"].T)  # [T, rank]
+        tcn_params = {"params": self.params["tcn"]}
+        ar_coef = jnp.asarray(self.params["ar"])
+        for _ in range(horizon):
+            win = xt[-self.window:][None]  # [1, window, rank]
+            ar = jnp.einsum("bwk,kw->bk", win, ar_coef)
+            nxt = (ar + self.tcn.apply(tcn_params, win))[0]
+            xt = jnp.concatenate([xt, nxt[None]], axis=0)
+        x_fut = np.asarray(xt[-horizon:]).T  # [rank, horizon]
+        y_fut = self.params["F"] @ x_fut
+        return y_fut * self.y_std + self.y_mean
+
+    def evaluate(self, y_true: np.ndarray,
+                 metrics: Sequence[str] = ("mse",)) -> Dict[str, float]:
+        """Score a [n_series, horizon] continuation."""
+        y_true = np.asarray(y_true)
+        pred = self.predict(y_true.shape[1])
+        return automl_metrics.evaluate_all(metrics, y_true, pred)

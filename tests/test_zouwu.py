@@ -1,0 +1,129 @@
+"""Zouwu tests: forecasters, TCMF, anomaly detection, AutoTS end-to-end.
+
+Mirrors the reference suite (ref: pyzoo/test/zoo/zouwu/).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.zouwu import (AutoTSTrainer, LSTMForecaster,
+                                     MTNetForecaster, TCMFForecaster,
+                                     TCNForecaster, ThresholdDetector,
+                                     ThresholdEstimator, TSPipeline)
+from analytics_zoo_tpu.automl.recipes import SmokeRecipe
+
+
+def _windows(n=128, past=8, seed=0):
+    rng = np.random.RandomState(seed)
+    series = np.sin(np.arange(n + past + 1) / 5.0) + \
+        0.05 * rng.randn(n + past + 1)
+    x = np.stack([series[i:i + past] for i in range(n)])[..., None]
+    y = series[past:past + n, None]
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_lstm_forecaster_learns(tmp_path):
+    x, y = _windows()
+    f = LSTMForecaster(target_dim=1, feature_dim=1, lstm_1_units=16,
+                       lstm_2_units=8, lr=0.01)
+    first = f.fit(x, y, epochs=1, batch_size=32)
+    final = f.fit(x, y, epochs=4, batch_size=32)
+    assert final < first  # training reduces validation mse
+    # the second fit must CONTINUE training, not rebuild: 5 total epochs
+    assert f.model.estimator.epoch == 5
+    preds = f.predict(x)
+    assert preds.shape == (128, 1)
+    res = f.evaluate(x, y, metrics=["mse", "rmse", "smape"])
+    assert res["rmse"] == pytest.approx(np.sqrt(res["mse"]), rel=1e-5)
+    f.save(str(tmp_path / "f"))
+    g = LSTMForecaster()
+    g.restore(str(tmp_path / "f"))
+    np.testing.assert_allclose(g.predict(x), preds, atol=1e-5)
+
+
+def test_mtnet_forecaster_shapes():
+    # long_series_num=2, series_length=4 -> past window of 12
+    f = MTNetForecaster(target_dim=1, feature_dim=1, long_series_num=2,
+                        series_length=4, ar_window_size=3, cnn_height=2)
+    x, y = _windows(n=96, past=f.past_seq_len)
+    f.fit(x, y, epochs=2, batch_size=32)
+    assert f.predict(x).shape == (96, 1)
+
+
+def test_tcn_forecaster_multi_horizon():
+    x, y = _windows(n=96, past=16)
+    y3 = np.concatenate([y, np.roll(y, -1), np.roll(y, -2)], axis=1)
+    f = TCNForecaster(horizon=3, levels=2, hidden=8)
+    f.fit(x, y3, epochs=2)
+    assert f.predict(x).shape == (96, 3)
+
+
+def test_tcmf_forecaster_low_rank_recovery():
+    """TCMF on exactly-low-rank smooth series must reconstruct and
+    extrapolate far better than the series scale."""
+    rng = np.random.RandomState(0)
+    t = np.arange(80)
+    basis = np.stack([np.sin(t / 6.0), np.cos(t / 9.0)])  # [2, 80]
+    mix = rng.randn(6, 2)
+    y = (mix @ basis).astype(np.float32)  # [6, 80] rank-2
+    train, future = y[:, :72], y[:, 72:]
+    f = TCMFForecaster(rank=4, tcn_levels=2, tcn_hidden=16, window=12,
+                       lr=0.02)
+    losses = f.fit(train, epochs=300)
+    assert losses["recon"] < 0.05
+    pred = f.predict(horizon=8)
+    assert pred.shape == (6, 8)
+    res = f.evaluate(future, metrics=["mse"])
+    assert res["mse"] < 0.1 * np.var(y)  # far beats predict-the-mean
+
+
+def test_threshold_estimator_and_detector():
+    rng = np.random.RandomState(0)
+    y = rng.randn(200, 2)
+    yhat = y + 0.01 * rng.randn(200, 2)
+    y[17] += 10.0  # inject anomalies
+    y[99] -= 8.0
+    th = ThresholdEstimator().fit(y, yhat, ratio=0.01)
+    idx = ThresholdDetector().detect(y, yhat, threshold=th)
+    assert 17 in idx and 99 in idx and len(idx) <= 4
+    # gaussian mode gives a finite, positive threshold
+    th_g = ThresholdEstimator().fit(y, yhat, mode="gaussian", ratio=0.01)
+    assert np.isfinite(th_g) and th_g > 0
+
+
+def test_threshold_detector_forms():
+    y = np.array([[0.0, 0.0], [5.0, 0.0], [0.0, 0.0]])
+    yhat = np.zeros_like(y)
+    # scalar
+    assert ThresholdDetector().detect(y, yhat, 1.0).tolist() == [1]
+    # per-sample
+    per_sample = np.array([10.0, 1.0, 10.0])
+    assert ThresholdDetector().detect(y, yhat, per_sample).tolist() == [1]
+    # per-dimension
+    per_dim = np.full_like(y, 6.0)
+    per_dim[1, 0] = 1.0
+    assert ThresholdDetector().detect(y, yhat, per_dim).tolist() == [1]
+    # (min, max) range ignores yhat
+    idx = ThresholdDetector().detect(y, threshold=(-1.0, 1.0))
+    assert idx.tolist() == [1]
+    with pytest.raises(ValueError, match="min exceeds max"):
+        ThresholdDetector().detect(y, threshold=(1.0, -1.0))
+
+
+def test_autots_end_to_end(tmp_path):
+    n = 120
+    dt = pd.date_range("2021-01-01", periods=n, freq="1h")
+    df = pd.DataFrame({
+        "datetime": dt,
+        "value": np.sin(np.arange(n) / 8.0).astype(np.float32)})
+    train_df, val_df = df.iloc[:100], df.iloc[90:]
+    trainer = AutoTSTrainer(horizon=1)
+    pipeline = trainer.fit(train_df, validation_df=val_df,
+                           recipe=SmokeRecipe())
+    assert np.isfinite(pipeline.evaluate(val_df)["mse"])
+    pred = pipeline.predict(val_df)
+    assert {"datetime", "value"} <= set(pred.columns)
+    pipeline.save(str(tmp_path / "p"))
+    loaded = TSPipeline.load(str(tmp_path / "p"))
+    pd.testing.assert_frame_equal(loaded.predict(val_df), pred)
