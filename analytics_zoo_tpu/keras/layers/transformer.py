@@ -11,7 +11,7 @@ North-star workload #4 (BERT-base fine-tune) builds on BERT here.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -27,13 +27,15 @@ class MultiHeadSelfAttention(nn.Module):
     n_head: int
     attn_dropout: float = 0.0
     causal: bool = False
+    dtype: Any = jnp.float32  # compute dtype; params stay fp32
 
     @nn.compact
     def __call__(self, x, mask=None, key_padding_mask=None,
                  train: bool = False):
         b, l, _ = x.shape
         hd = self.hidden_size // self.n_head
-        qkv = nn.Dense(3 * self.hidden_size, name="qkv")(x)
+        qkv = nn.Dense(3 * self.hidden_size, dtype=self.dtype,
+                       name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
@@ -47,7 +49,8 @@ class MultiHeadSelfAttention(nn.Module):
             dropout_rate=self.attn_dropout if train else 0.0,
             dropout_rng=rng)
         out = out.transpose(0, 2, 1, 3).reshape(b, l, self.hidden_size)
-        return nn.Dense(self.hidden_size, name="proj")(out)
+        return nn.Dense(self.hidden_size, dtype=self.dtype,
+                        name="proj")(out)
 
 
 class TransformerBlock(nn.Module):
@@ -61,6 +64,7 @@ class TransformerBlock(nn.Module):
     attn_dropout: float = 0.1
     causal: bool = False
     activation: str = "gelu"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, mask=None, key_padding_mask=None,
@@ -68,17 +72,21 @@ class TransformerBlock(nn.Module):
         act = jax.nn.gelu if self.activation == "gelu" else jax.nn.relu
         attn = MultiHeadSelfAttention(
             self.hidden_size, self.n_head, attn_dropout=self.attn_dropout,
-            causal=self.causal, name="attention")(
+            causal=self.causal, dtype=self.dtype, name="attention")(
                 x, mask=mask, key_padding_mask=key_padding_mask,
                 train=train)
         attn = nn.Dropout(self.hidden_dropout,
                           deterministic=not train)(attn)
-        x = nn.LayerNorm(epsilon=1e-5, name="ln_attn")(x + attn)
-        h = nn.Dense(self.intermediate_size, name="ffn_in")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
+                         name="ln_attn")(x + attn)
+        h = nn.Dense(self.intermediate_size, dtype=self.dtype,
+                     name="ffn_in")(x)
         h = act(h)
-        h = nn.Dense(self.hidden_size, name="ffn_out")(h)
+        h = nn.Dense(self.hidden_size, dtype=self.dtype,
+                     name="ffn_out")(h)
         h = nn.Dropout(self.hidden_dropout, deterministic=not train)(h)
-        return nn.LayerNorm(epsilon=1e-5, name="ln_ffn")(x + h)
+        return nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
+                            name="ln_ffn")(x + h)
 
 
 class TransformerModule(nn.Module):
@@ -94,6 +102,7 @@ class TransformerModule(nn.Module):
     hidden_dropout: float = 0.1
     attn_dropout: float = 0.1
     output_all_block: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -112,7 +121,7 @@ class TransformerModule(nn.Module):
                 self.hidden_size, self.n_head, inter,
                 hidden_dropout=self.hidden_dropout,
                 attn_dropout=self.attn_dropout, causal=True,
-                name=f"block_{i}")(h, train=train)
+                dtype=self.dtype, name=f"block_{i}")(h, train=train)
             outs.append(h)
         return tuple(outs) if self.output_all_block else h
 
@@ -135,6 +144,7 @@ class BERTModule(nn.Module):
     type_vocab: int = 2
     hidden_dropout: float = 0.1
     attn_dropout: float = 0.1
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -162,6 +172,7 @@ class BERTModule(nn.Module):
                 self.hidden_size, self.n_head, self.intermediate_size,
                 hidden_dropout=self.hidden_dropout,
                 attn_dropout=self.attn_dropout, causal=False,
+                dtype=self.dtype,
                 name=f"encoder_{i}")(h, key_padding_mask=attn_mask,
                                      train=train)
         pooled = jnp.tanh(nn.Dense(self.hidden_size, name="pooler")

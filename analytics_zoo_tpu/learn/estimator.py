@@ -211,7 +211,7 @@ class Estimator:
         adapter, loss_fn, tx = self.adapter, self.loss_fn, self.tx
         donate = get_config().get("zoo.train.donate_buffers")
 
-        def step(variables, opt_state, x, y, rng):
+        def step(variables, opt_state, loss_sum, x, y, rng):
             params = variables.get("params", {})
             extra = {k: v for k, v in variables.items() if k != "params"}
 
@@ -226,10 +226,15 @@ class Estimator:
             import optax
 
             params = optax.apply_updates(params, updates)
-            return {"params": params, **new_extra}, opt_state, loss
+            # the epoch loss accumulates ON DEVICE: pulling per-step
+            # scalars to host costs a full round-trip each (catastrophic
+            # over remote dispatch links); the epoch mean is one
+            # transfer of this resident scalar
+            return ({"params": params, **new_extra}, opt_state,
+                    loss_sum + loss, loss)
 
         self._train_step = jax.jit(
-            step, donate_argnums=(0, 1) if donate else ())
+            step, donate_argnums=(0, 1, 2) if donate else ())
         return self._train_step
 
     def _eval_metrics(self) -> List[Metric]:
@@ -313,7 +318,8 @@ class Estimator:
 
         while self.epoch < epochs:
             epoch_start = time.time()
-            losses: List[float] = []
+            loss_sum = jnp.zeros((), jnp.float32)
+            n_steps = 0
             last_val: Optional[Dict[str, float]] = None
             try:
                 for step_in_epoch, (x, y) in enumerate(
@@ -321,10 +327,11 @@ class Estimator:
                             batch_size, mesh=self.mesh, shuffle=True,
                             seed=self.seed, epoch=self.epoch)):
                     self._rng, step_rng = jax.random.split(self._rng)
-                    self.variables, self.opt_state, loss = train_step(
-                        self.variables, self.opt_state, x, y, step_rng)
+                    (self.variables, self.opt_state, loss_sum,
+                     loss) = train_step(self.variables, self.opt_state,
+                                        loss_sum, x, y, step_rng)
                     self.global_step += 1
-                    losses.append(loss)  # device scalar; sync at epoch end
+                    n_steps += 1
                     if (self.global_step % log_every == 0 or
                             self.global_step == 1):
                         lf = float(loss)
@@ -356,13 +363,13 @@ class Estimator:
                         ckpt_lib.save_checkpoint(
                             checkpoint_dir, self.variables, self.opt_state,
                             self.global_step, state.epoch)
-                # epoch completed
+                # epoch completed; ONE host sync for the whole epoch
                 self.epoch += 1
                 state.epoch = self.epoch
                 entry: Dict[str, float] = {
                     "epoch": self.epoch,
-                    "loss": (float(np.mean([float(l) for l in losses]))
-                             if losses else float("nan")),
+                    "loss": (float(loss_sum) / n_steps if n_steps
+                             else float("nan")),
                     "seconds": time.time() - epoch_start,
                 }
                 if last_val is not None:

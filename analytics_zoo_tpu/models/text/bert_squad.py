@@ -1,0 +1,121 @@
+"""BERT-SQuAD: extractive question answering fine-tune workflow.
+
+The analog of the TFPark BERT-SQuAD estimator (ref: pyzoo/zoo/tfpark/
+text/estimator/bert_squad.py:78 -- BERT encoder + a dense span head
+emitting start/end logits, trained with mean start/end cross-entropy;
+model_fn pattern in bert_base.py:115-134). North-star workload #4.
+
+TPU notes: the encoder runs through the flash-attention dispatch (no
+[L, L] score matrix in HBM); pass ``dtype="bfloat16"`` to keep the MXU
+on its native precision (params stay fp32).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras.layers.transformer import BERTModule
+from analytics_zoo_tpu.models.common import ZooModel, register_model
+
+
+def squad_span_loss(preds, labels):
+    """Mean of start/end cross-entropies (ref: bert_squad.py loss).
+
+    preds: (start_logits [B, L], end_logits [B, L]);
+    labels: [B, 2] int (start, end) positions.
+    """
+    start_logits, end_logits = preds
+    labels = labels.astype(jnp.int32)
+    start_ll = jax.nn.log_softmax(start_logits.astype(jnp.float32), -1)
+    end_ll = jax.nn.log_softmax(end_logits.astype(jnp.float32), -1)
+    b = start_logits.shape[0]
+    rows = jnp.arange(b)
+    start_loss = -start_ll[rows, labels[:, 0]]
+    end_loss = -end_ll[rows, labels[:, 1]]
+    return jnp.mean((start_loss + end_loss) / 2.0)
+
+
+class BERTForSQuAD(nn.Module):
+    """BERT encoder + span head -> (start_logits, end_logits)."""
+
+    vocab: int
+    hidden_size: int = 768
+    n_block: int = 12
+    n_head: int = 12
+    intermediate_size: int = 3072
+    max_position_len: int = 512
+    hidden_dropout: float = 0.1
+    attn_dropout: float = 0.0  # 0 keeps the flash kernel engaged
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        seq, _ = BERTModule(
+            vocab=self.vocab, hidden_size=self.hidden_size,
+            n_block=self.n_block, n_head=self.n_head,
+            intermediate_size=self.intermediate_size,
+            max_position_len=self.max_position_len,
+            hidden_dropout=self.hidden_dropout,
+            attn_dropout=self.attn_dropout, dtype=self.dtype,
+            name="bert")(x, train=train)
+        logits = nn.Dense(2, dtype=jnp.float32, name="span_head")(seq)
+        start, end = jnp.split(logits, 2, axis=-1)
+        return start.squeeze(-1), end.squeeze(-1)
+
+
+@register_model
+class BERTSQuAD(ZooModel):
+    """(ref: bert_squad.py BERTSQuADEstimator). fit expects
+    x = {"input_ids", optional "token_type_ids"/"attention_mask"},
+    y = [B, 2] (start, end) positions; predict returns span logits."""
+
+    default_loss = staticmethod(squad_span_loss)
+    default_optimizer = "adam"
+    default_metrics = ()
+
+    def __init__(self, vocab: int, hidden_size: int = 768,
+                 n_block: int = 12, n_head: int = 12,
+                 intermediate_size: int = 3072,
+                 max_position_len: int = 512,
+                 hidden_dropout: float = 0.1, dtype: str = "float32"):
+        super().__init__(vocab=vocab, hidden_size=hidden_size,
+                         n_block=n_block, n_head=n_head,
+                         intermediate_size=intermediate_size,
+                         max_position_len=max_position_len,
+                         hidden_dropout=hidden_dropout, dtype=dtype)
+
+    def _build_module(self):
+        c = self._config
+        return BERTForSQuAD(
+            vocab=c["vocab"], hidden_size=c["hidden_size"],
+            n_block=c["n_block"], n_head=c["n_head"],
+            intermediate_size=c["intermediate_size"],
+            max_position_len=c["max_position_len"],
+            hidden_dropout=c["hidden_dropout"],
+            dtype=jnp.dtype(c["dtype"]))
+
+    def _example_input(self):
+        return {"input_ids": np.zeros((1, 16), np.int32)}
+
+    @staticmethod
+    def decode_spans(start_logits, end_logits,
+                     max_answer_len: int = 30) -> np.ndarray:
+        """Best (start, end) span per sample with end >= start and
+        length <= max_answer_len (ref: squad postprocessing)."""
+        start_logits = np.asarray(start_logits)
+        end_logits = np.asarray(end_logits)
+        b, l = start_logits.shape
+        out = np.zeros((b, 2), np.int32)
+        for i in range(b):
+            scores = start_logits[i][:, None] + end_logits[i][None, :]
+            valid = np.triu(np.ones((l, l), bool))
+            valid &= ~np.triu(np.ones((l, l), bool), k=max_answer_len)
+            scores = np.where(valid, scores, -np.inf)
+            flat = int(np.argmax(scores))
+            out[i] = divmod(flat, l)
+        return out

@@ -1,19 +1,24 @@
 #!/usr/bin/env python
-"""Benchmark: NCF training throughput (north-star workload #1).
+"""Benchmark: NCF training throughput + BERT-base fine-tune steps/sec.
 
-Measures samples/sec/chip for NeuralCF on MovieLens-1M-scale synthetic
-data through the full Estimator SPMD train path (ref workload:
-apps/recommendation-ncf/ncf-explicit-feedback.ipynb via NNEstimator,
-BASELINE.md config #1).
+Covers both BASELINE.md north-star training metrics, honestly:
+- NCF (workload #1): samples/sec/chip through the FULL ``Estimator.fit``
+  loop -- input pipeline, host->device transfer, trigger bookkeeping and
+  all (ref workload: apps/recommendation-ncf/ncf-explicit-feedback.ipynb).
+- BERT-base fine-tune (workload #4): steps/sec through ``Estimator.fit``
+  on the SQuAD span task, seq_len 384, bf16 compute, flash-attention
+  path (ref workload: pyzoo/zoo/tfpark/text/estimator/bert_squad.py:78).
 
-``vs_baseline`` is the speedup over the identical train step on the host
-CPU (measured in a subprocess, cached in .bench_cpu_baseline.json): the
-reference is a CPU/MKL framework, so TPU-vs-host-CPU through the same
-code path is the meaningful ratio while the reference publishes no
-absolute numbers (BASELINE.md).
+Each metric carries an analytic MFU estimate (model FLOPs / wall time /
+chip peak) as a roofline sanity check.
+
+``vs_baseline`` is the speedup over the identical NCF fit loop on host
+CPU (subprocess, cached): the reference is a CPU/MKL framework and
+publishes no absolute numbers (BASELINE.md), so TPU-vs-host-CPU through
+the same code path is the meaningful ratio.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric", "value", "unit", "vs_baseline", "extras": {...}}
 """
 
 import json
@@ -27,68 +32,132 @@ sys.path.insert(0, REPO)
 
 # MovieLens-1M scale (ref: ml-1m 6040 users / 3706 movies, 5-star ratings)
 USERS, ITEMS, CLASSES = 6040, 3706, 5
-BATCH = 8192
-WARMUP_STEPS = 5
-MEASURE_STEPS = 30
+NCF_BATCH = 65536
+NCF_EPOCHS = 3  # first epoch absorbs compile; later epochs measured
+
+# BERT-base SQuAD fine-tune config (ref: bert_squad.py / BERT-base)
+BERT_VOCAB, BERT_SEQ = 30522, 384
+BERT_BATCH = 32
+BERT_STEPS = 24
+
 CPU_BASELINE_FILE = os.path.join(REPO, ".bench_cpu_baseline.json")
 
+# bf16 peak of one TPU v5e chip; MFU vs bf16 peak is the standard
+# roofline convention
+PEAK_FLOPS = {"tpu": 197e12, "cpu": 2e12}
 
-def measure(steps: int, warmup: int, batch: int) -> float:
-    """Samples/sec of the NCF train step on the current jax platform."""
+
+def _peak():
     import jax
+
+    return PEAK_FLOPS.get(jax.devices()[0].platform, 2e12)
+
+
+class _EpochTimer:
+    """Wall-clock per completed epoch, measured around Estimator.fit via
+    the returned history (fit already reports per-epoch seconds)."""
+
+
+def measure_ncf(batch: int, epochs: int):
+    """Samples/sec through the full Estimator.fit loop (epoch 1 excluded:
+    it holds the one-time XLA compile)."""
     import numpy as np
 
+    from analytics_zoo_tpu.common.config import get_config
     from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF
 
+    # every log line forces a device->host scalar sync; over a remote
+    # dispatch link that is ~100ms each, so log sparsely while benching
+    get_config().set("zoo.train.log_every_n_steps", 100000)
     rng = np.random.RandomState(0)
-    n = batch * 4
+    n = batch * 64
     x = np.stack([rng.randint(1, USERS + 1, n),
                   rng.randint(1, ITEMS + 1, n)], axis=1).astype(np.int32)
     y = rng.randint(1, CLASSES + 1, n).astype(np.int32)
 
     model = NeuralCF(USERS, ITEMS, class_num=CLASSES)
+    history = model.fit((x, y), batch_size=batch, epochs=epochs)
+    steady = history[1:] or history
+    seconds = sum(h["seconds"] for h in steady)
+    steps = len(steady) * (n // batch)
+    samples_per_sec = steps * batch / seconds
+
+    # analytic model FLOPs/sample: fwd matmul 2*P_dense, bwd ~2x -> 6x
+    p_dense = _dense_params(model.estimator.variables)
+    flops_per_sample = 6 * p_dense
+    mfu = samples_per_sec * flops_per_sample / _peak()
+    return samples_per_sec, mfu
+
+
+def measure_bert(batch: int, seq: int, steps: int):
+    """BERT-base SQuAD fine-tune steps/sec through Estimator.fit."""
+    import numpy as np
+
+    from analytics_zoo_tpu.models.text.bert_squad import BERTSQuAD
+
+    rng = np.random.RandomState(0)
+    n = batch * steps
+    x = {"input_ids": rng.randint(0, BERT_VOCAB, (n, seq)
+                                  ).astype(np.int32)}
+    y = np.stack([rng.randint(0, seq, n), rng.randint(0, seq, n)],
+                 axis=1).astype(np.int32)
+
+    model = BERTSQuAD(vocab=BERT_VOCAB, dtype="bfloat16")
+    # epoch 1: compile + steady steps; epoch 2: measured clean
+    model.fit((x, y), batch_size=batch, epochs=2)
     est = model.estimator
-    est._ensure_built(x[:1])
-    step_fn = est._build_train_step()
-
-    from analytics_zoo_tpu.parallel.sharding import shard_batch
-
-    xb = shard_batch(x[:batch], est.mesh)
-    yb = shard_batch(y[:batch], est.mesh)
-    key = jax.random.PRNGKey(0)
-
-    variables, opt_state = est.variables, est.opt_state
-    for _ in range(warmup):
-        variables, opt_state, loss = step_fn(variables, opt_state, xb, yb,
-                                             key)
-    jax.block_until_ready(loss)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        variables, opt_state, loss = step_fn(variables, opt_state, xb, yb,
-                                             key)
-    jax.block_until_ready(loss)
+    model.fit((x, y), batch_size=batch, epochs=3)
     dt = time.perf_counter() - t0
-    return steps * batch / dt
+    steps_per_sec = steps / dt
+
+    # standard transformer estimate: 6*P per token + attention
+    # 12*L*H*n_layer per token (fwd+bwd)
+    p_dense = _dense_params(est.variables)
+    c = model._config
+    flops_per_token = (6 * p_dense +
+                       12 * c["n_block"] * c["hidden_size"] * seq)
+    mfu = steps_per_sec * batch * seq * flops_per_token / _peak()
+    return steps_per_sec, mfu
+
+
+def _dense_params(variables) -> int:
+    """Parameter count excluding embedding tables (embeddings are
+    gathers, not matmuls)."""
+    import jax
+
+    total = 0
+    flat = jax.tree_util.tree_flatten_with_path(
+        variables.get("params", variables))[0]
+    for path, leaf in flat:
+        name = "/".join(str(p) for p in path).lower()
+        if "embed" in name:
+            continue
+        total += int(leaf.size)
+    return total
 
 
 def cpu_baseline() -> float:
-    """Measure (or load cached) host-CPU samples/sec for vs_baseline."""
+    """Measure (or load cached) host-CPU NCF samples/sec."""
     if os.path.isfile(CPU_BASELINE_FILE):
         with open(CPU_BASELINE_FILE) as f:
-            return json.load(f)["samples_per_sec"]
+            cached = json.load(f)
+            if cached.get("version") == 2:
+                return cached["samples_per_sec"]
     code = (
         "import sys; sys.path.insert(0, %r)\n"
         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
         "import bench\n"
-        "v = bench.measure(steps=5, warmup=2, batch=bench.BATCH)\n"
+        "v, _ = bench.measure_ncf(batch=bench.NCF_BATCH, epochs=2)\n"
         "print('CPU_RESULT', v)\n" % REPO)
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                         text=True, timeout=1200, cwd=REPO)
+                         text=True, timeout=2400, cwd=REPO)
     for line in out.stdout.splitlines():
         if line.startswith("CPU_RESULT"):
             v = float(line.split()[1])
             with open(CPU_BASELINE_FILE, "w") as f:
-                json.dump({"samples_per_sec": v, "batch": BATCH}, f)
+                json.dump({"samples_per_sec": v, "batch": NCF_BATCH,
+                           "version": 2}, f)
             return v
     raise RuntimeError(f"cpu baseline failed: {out.stderr[-2000:]}")
 
@@ -97,19 +166,46 @@ def main():
     import jax
 
     n_chips = len(jax.devices())
-    total = measure(MEASURE_STEPS, WARMUP_STEPS, BATCH)
-    per_chip = total / n_chips
+    ncf_total, ncf_mfu = measure_ncf(NCF_BATCH, NCF_EPOCHS)
+    ncf_per_chip = ncf_total / n_chips
+    bert_batch = BERT_BATCH
+    try:
+        bert_sps, bert_mfu = measure_bert(bert_batch, BERT_SEQ,
+                                          BERT_STEPS)
+    except Exception as e:  # remote-compile hiccups: retry smaller
+        print(f"warning: bert bench at batch {bert_batch} failed: {e}; "
+              "retrying at 16", file=sys.stderr)
+        try:
+            bert_batch = 16
+            bert_sps, bert_mfu = measure_bert(bert_batch, BERT_SEQ,
+                                              BERT_STEPS)
+        except Exception as e2:  # report NCF even if BERT cannot run
+            print(f"warning: bert bench failed: {e2}", file=sys.stderr)
+            bert_sps, bert_mfu = None, None
     try:
         base = cpu_baseline()
-        vs = total / base
+        vs = ncf_total / base
     except Exception as e:  # never let baseline kill the bench line
         print(f"warning: cpu baseline unavailable: {e}", file=sys.stderr)
         vs = 1.0
+    extras = {
+        "ncf_mfu": round(ncf_mfu, 6),
+        "ncf_note": "full Estimator.fit loop incl. input pipeline",
+    }
+    if bert_sps is not None:
+        extras.update({
+            "bert_finetune_steps_per_sec": round(bert_sps, 3),
+            "bert_batch": bert_batch, "bert_seq_len": BERT_SEQ,
+            "bert_mfu": round(bert_mfu, 4),
+            "bert_note": "BERT-base SQuAD span task, bf16 compute, "
+                         "flash attention, full fit loop",
+        })
     print(json.dumps({
         "metric": "ncf_train_samples_per_sec_per_chip",
-        "value": round(per_chip, 1),
+        "value": round(ncf_per_chip, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs, 2),
+        "extras": extras,
     }))
 
 

@@ -1,0 +1,74 @@
+"""BERT-SQuAD fine-tune workflow tests (north-star workload #4).
+
+(ref: pyzoo/zoo/tfpark/text/estimator/bert_squad.py, test strategy per
+pyzoo/test/zoo/tfpark/test_text_estimators.py)
+"""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.text.bert_squad import (BERTSQuAD,
+                                                      BERTForSQuAD,
+                                                      squad_span_loss)
+
+
+def _data(n=32, seq=16, vocab=50, seed=0):
+    rng = np.random.RandomState(seed)
+    x = {"input_ids": rng.randint(0, vocab, (n, seq)).astype(np.int32)}
+    y = np.stack([rng.randint(0, seq, n), rng.randint(0, seq, n)],
+                 axis=1).astype(np.int32)
+    return x, y
+
+
+def test_squad_span_loss_perfect_prediction_is_small():
+    import jax.numpy as jnp
+
+    seq, b = 8, 4
+    y = np.stack([np.arange(b) % seq, (np.arange(b) + 1) % seq], axis=1)
+    big = 20.0
+    start = np.full((b, seq), -big, np.float32)
+    end = np.full((b, seq), -big, np.float32)
+    start[np.arange(b), y[:, 0]] = big
+    end[np.arange(b), y[:, 1]] = big
+    loss = float(squad_span_loss((jnp.asarray(start), jnp.asarray(end)),
+                                 jnp.asarray(y)))
+    assert loss < 1e-3
+    uniform = float(squad_span_loss(
+        (jnp.zeros((b, seq)), jnp.zeros((b, seq))), jnp.asarray(y)))
+    assert uniform == pytest.approx(np.log(seq), rel=1e-5)
+
+
+def test_bert_squad_finetune_loss_drops():
+    x, y = _data()
+    model = BERTSQuAD(vocab=50, hidden_size=32, n_block=2, n_head=2,
+                      intermediate_size=64, max_position_len=32)
+    model.compile(optimizer="adam")
+    history = model.fit((x, y), batch_size=16, epochs=6)
+    assert history[-1]["loss"] < history[0]["loss"]
+    start, end = model.predict(x, batch_size=16)
+    assert start.shape == (32, 16) and end.shape == (32, 16)
+
+
+def test_bert_squad_bf16_matches_shapes():
+    import jax
+
+    x, y = _data(n=8)
+    module = BERTForSQuAD(vocab=50, hidden_size=32, n_block=1, n_head=2,
+                          intermediate_size=64, max_position_len=32,
+                          dtype="bfloat16")
+    v = module.init(jax.random.PRNGKey(0), x)
+    start, end = module.apply(v, x)
+    assert start.shape == (8, 16)
+    # params stay fp32 under bf16 compute
+    assert all(l.dtype == np.float32
+               for l in jax.tree_util.tree_leaves(v["params"]))
+
+
+def test_decode_spans_respects_constraints():
+    rng = np.random.RandomState(0)
+    start = rng.randn(5, 20).astype(np.float32)
+    end = rng.randn(5, 20).astype(np.float32)
+    spans = BERTSQuAD.decode_spans(start, end, max_answer_len=5)
+    assert spans.shape == (5, 2)
+    assert np.all(spans[:, 1] >= spans[:, 0])
+    assert np.all(spans[:, 1] - spans[:, 0] < 5)
