@@ -90,7 +90,12 @@ class MaxScore(Trigger):
 
 
 class MinLoss(Trigger):
-    """Fires when training loss drops below ``min_loss``."""
+    """Fires when training loss drops below ``min_loss``.
+
+    The Estimator materializes loss on host only at its logging cadence
+    (``zoo.train.log_every_n_steps``), so this trigger observes the loss
+    at that granularity -- keeping the train loop free of per-step
+    device->host syncs."""
 
     def __init__(self, min_loss: float):
         self.min_loss = min_loss

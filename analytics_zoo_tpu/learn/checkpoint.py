@@ -22,6 +22,7 @@ import jax
 from flax import serialization
 
 from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.parallel import sharding as sharding_lib
 
 logger = get_logger(__name__)
 
@@ -30,10 +31,13 @@ def save_checkpoint(ckpt_dir: str, variables: Any, opt_state: Any,
                     step: int, epoch: int,
                     extra_meta: Optional[Dict] = None) -> str:
     """Write a snapshot; returns the checkpoint path prefix."""
+    # with cross-host parameter sharding (param_spec_fn) arrays are not
+    # fully addressable on process 0, so gather collectively first --
+    # every process must participate, hence outside the index-0 branch
+    host_vars = sharding_lib.gather_to_host(variables)
+    host_opt = sharding_lib.gather_to_host(opt_state)
     if jax.process_index() == 0:
         os.makedirs(ckpt_dir, exist_ok=True)
-        host_vars = jax.device_get(variables)
-        host_opt = jax.device_get(opt_state)
         _atomic_write(os.path.join(ckpt_dir, f"model.{step}"),
                       serialization.to_bytes(host_vars))
         _atomic_write(os.path.join(ckpt_dir, f"optim.{step}"),

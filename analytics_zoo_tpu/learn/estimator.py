@@ -33,6 +33,7 @@ from analytics_zoo_tpu.learn import checkpoint as ckpt_lib
 from analytics_zoo_tpu.learn.metrics import Metric, resolve_metric
 from analytics_zoo_tpu.learn.objectives import resolve_loss
 from analytics_zoo_tpu.learn.optim import resolve_optimizer
+from analytics_zoo_tpu.parallel import sharding
 from analytics_zoo_tpu.parallel.mesh import default_mesh
 from analytics_zoo_tpu.parallel.sharding import replicated
 
@@ -335,6 +336,10 @@ class Estimator:
                     if (self.global_step % log_every == 0 or
                             self.global_step == 1):
                         lf = float(loss)
+                        # loss reaches triggers at log cadence only: a
+                        # per-step float() would force a host sync every
+                        # step and kill async dispatch
+                        state.loss = lf
                         logger.info("epoch %d step %d loss %.5f",
                                     self.epoch, self.global_step, lf)
                         if writer:
@@ -347,7 +352,6 @@ class Estimator:
                     # global_step off the modulo grid.
                     finishing = step_in_epoch == steps_per_epoch - 1
                     state.iteration = self.global_step
-                    state.loss = loss
                     state.epoch = self.epoch + (1 if finishing else 0)
                     state.epoch_finished = finishing
                     state.wall_time = time.time()
@@ -390,6 +394,9 @@ class Estimator:
                     len(failures), retry_times, e)
                 if not can_retry:
                     raise
+                # the restored model's loss is unknown until the next log
+                # step; a stale pre-crash value would misfire MinLoss
+                state.loss = None
                 self._restore(checkpoint_dir)
         return history
 
@@ -439,21 +446,14 @@ class Estimator:
                                                    training=False)[0])
         fn = self._predict_fns["predict"]
 
-        def to_host(out):
-            if jax.process_count() > 1:
-                # globally-sharded outputs are not fully addressable per
-                # host; all-gather them (batch order is preserved because
-                # batches() hands each process its contiguous block)
-                from jax.experimental import multihost_utils
-
-                return multihost_utils.process_allgather(out, tiled=True)
-            return jax.device_get(out)
-
+        # globally-sharded outputs are not fully addressable per host;
+        # gather_to_host all-gathers them (batch order is preserved
+        # because batches() hands each process its contiguous block)
         outs: List[Any] = []
         for x, _ in dataset.device_iterator(batch_size, mesh=self.mesh,
                                             shuffle=False,
                                             drop_remainder=False):
-            outs.append(to_host(fn(self.variables, x)))
+            outs.append(sharding.gather_to_host(fn(self.variables, x)))
         result = jax.tree_util.tree_map(
             lambda *parts: np.concatenate(parts)[:dataset.num_samples],
             *outs)

@@ -102,8 +102,9 @@ def kullback_leibler_divergence(preds, labels):
 
 
 def rank_hinge(preds, labels, margin: float = 1.0):
-    """Pairwise ranking hinge for (pos, neg) pair batches: preds [2B] or
-    [B,2] with positives first (ref: objectives/RankHinge.scala used by
+    """Pairwise ranking hinge over interleaved (pos, neg) pairs: preds
+    [B,2] rows of (pos, neg), or flat [2B] laid out
+    pos0,neg0,pos1,neg1,... (ref: objectives/RankHinge.scala used by
     KNRM text matching)."""
     flat = preds.reshape(-1)
     pos, neg = flat[0::2], flat[1::2]

@@ -65,7 +65,8 @@ def dot_product_attention(q, k, v, mask=None, key_padding_mask=None,
 
     flash_ok = (mask is None and dropout_rate == 0.0
                 and _platform(q) == "tpu"
-                and l % 128 == 0 and lk % 128 == 0)
+                and l % 128 == 0 and lk % 128 == 0
+                and not (causal and l > lk))
     if flash_ok and d % 128 == 0:
         from analytics_zoo_tpu.ops.pallas_attention import (
             pallas_flash_attention_fwd)
@@ -73,7 +74,9 @@ def dot_product_attention(q, k, v, mask=None, key_padding_mask=None,
         if key_padding_mask is None:
             return pallas_flash_attention_fwd(q, k, v, causal, scale)
         flash_ok = True  # fall through to stock kernel for padding masks
-    if flash_ok and d <= 128:
+    # the stock kernel's causal mask is top-left aligned (no cross-length
+    # offset), so it only agrees with reference_attention when lq == lk
+    if flash_ok and d <= 128 and (not causal or l == lk):
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             SegmentIds, flash_attention)
 

@@ -30,7 +30,7 @@ NEG_INF = -1e30
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
                       causal: bool, scale: float, block_q: int,
-                      block_k: int):
+                      block_k: int, causal_offset: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -43,7 +43,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     # skip fully-masked kv blocks under causal masking
     run = True if not causal else (ki * block_k <= qi * block_q +
-                                   (block_q - 1))
+                                   (block_q - 1) + causal_offset)
 
     @pl.when(run)
     def _body():
@@ -54,7 +54,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [BQ, BK]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            # diagonal aligned bottom-right like the jnp reference path
+            # (reference_attention tril with k=lk-lq), so cross-length
+            # q/kv gives identical results on both dispatch paths
+            q_pos = qi * block_q + causal_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -89,6 +92,9 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
                          f"({block_q},{block_k})")
     if d % 128:
         raise ValueError(f"head_dim {d} must be a multiple of 128")
+    if causal and l > lk:
+        # rows attending to nothing are undefined under flash semantics
+        raise ValueError("causal attention requires len(q) <= len(kv)")
     qr = q.reshape(b * h, l, d)
     kr = k.reshape(b * h, lk, d)
     vr = v.reshape(b * h, lk, d)
@@ -97,7 +103,8 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
     interpret = jax.default_backend() != "tpu"
     out = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, causal=causal, scale=scale,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          causal_offset=lk - l),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),

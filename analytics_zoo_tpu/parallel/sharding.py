@@ -83,3 +83,25 @@ def batch_spec_tree(batch: Any, axis: str = DATA_AXIS) -> Any:
     """Pytree of PartitionSpecs sharding every leaf's leading dim."""
     return jax.tree_util.tree_map(
         lambda x: data_parallel_spec(x, axis), batch)
+
+
+def gather_to_host(tree: Any) -> Any:
+    """Materialize a pytree on every host as numpy.
+
+    Leaves sharded across hosts (not fully addressable) are assembled
+    into their global value with a collective ``process_allgather``;
+    fully-addressable leaves (host-local or replicated) are fetched
+    directly -- allgathering those would wrongly stack/concatenate the
+    per-process copies. Collective: every process must call this with
+    the same tree structure.
+    """
+    if jax.process_count() <= 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    def leaf(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return multihost_utils.process_allgather(x, tiled=True)
+        return jax.device_get(x)
+
+    return jax.tree_util.tree_map(leaf, tree)

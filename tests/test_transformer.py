@@ -124,6 +124,31 @@ class TestPallasKernel:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5)
 
+    @pytest.mark.parametrize("lq,lk", [(128, 384), (256, 384)])
+    def test_kernel_cross_length_causal_matches_reference(self, lq, lk):
+        # causal diagonal must align bottom-right (tril k=lk-lq) exactly
+        # like the jnp reference path, so both dispatch paths agree
+        from analytics_zoo_tpu.ops import (
+            pallas_flash_attention_fwd, reference_attention)
+
+        rng = np.random.RandomState(2)
+        b, h, d = 1, 2, 128
+        q = jnp.asarray(rng.randn(b, h, lq, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, h, lk, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, h, lk, d), jnp.float32)
+        out = pallas_flash_attention_fwd(q, k, v, True)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_kernel_rejects_causal_lq_gt_lk(self):
+        from analytics_zoo_tpu.ops import pallas_flash_attention_fwd
+
+        q = jnp.zeros((1, 1, 256, 128), jnp.float32)
+        k = jnp.zeros((1, 1, 128, 128), jnp.float32)
+        with pytest.raises(ValueError, match="len\\(q\\)"):
+            pallas_flash_attention_fwd(q, k, k, True)
+
     def test_kernel_grad_finite(self):
         from analytics_zoo_tpu.ops import pallas_flash_attention_fwd
 
