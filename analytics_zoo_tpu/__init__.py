@@ -22,6 +22,10 @@ Top-level subpackages (reference analog in parens):
 - ``ops``      -- Pallas TPU kernels (flash attention, ...)
 - ``inference``-- InferenceModel multi-format inference runtime
 - ``serving``  -- streaming model serving: queue + batcher + HTTP frontend
+- ``nnframes`` -- DataFrame fit/transform pipelines + Preprocessing
+                  (zoo/pipeline/nnframes Spark-ML integration)
+- ``feature``  -- TextSet/ImageSet preprocessing op libraries
+                  (zoo/feature text + image transformers, Relations)
 - ``models``   -- model zoo: recommendation, NLP, vision, time series
 - ``automl``   -- hyperparameter search engine + recipes
 - ``zouwu``    -- time series: forecasters, AutoTS, anomaly detection

@@ -1,0 +1,27 @@
+"""Feature layer: text and image preprocessing pipelines.
+
+The analog of the reference's feature package
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/feature/ -- the
+``TextSet``/``TextFeature`` text chain, the OpenCV-backed ``ImageSet``
+op library, and ``Relations`` QA ranking pairs; python surface
+pyzoo/zoo/feature/). Host-side numpy/PIL preprocessing feeding
+``ZooDataset``; the accelerator never sees variable shapes.
+"""
+
+from analytics_zoo_tpu.feature.text import (
+    Normalizer, Relation, Relations, SequenceShaper, TextFeature,
+    TextFeatureToSample, TextSet, Tokenizer, WordIndexer)
+from analytics_zoo_tpu.feature.image import (
+    ImageBrightness, ImageCenterCrop, ImageChannelNormalize,
+    ImageChannelOrder, ImageHFlip, ImageHue, ImageMatToTensor,
+    ImagePixelNormalizer, ImageRandomCrop, ImageRandomPreprocessing,
+    ImageResize, ImageSaturation, ImageSet, ImageSetToSample)
+
+__all__ = [
+    "TextFeature", "TextSet", "Tokenizer", "Normalizer", "WordIndexer",
+    "SequenceShaper", "TextFeatureToSample", "Relation", "Relations",
+    "ImageSet", "ImageResize", "ImageCenterCrop", "ImageRandomCrop",
+    "ImageHFlip", "ImageBrightness", "ImageHue", "ImageSaturation",
+    "ImageChannelNormalize", "ImagePixelNormalizer", "ImageChannelOrder",
+    "ImageMatToTensor", "ImageSetToSample", "ImageRandomPreprocessing",
+]

@@ -1,0 +1,338 @@
+"""ImageSet + composable image preprocessing ops.
+
+The analog of the reference's OpenCV-backed image op library
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/feature/image/ --
+ImageSet.scala, ImageResize.scala, ImageCenterCrop.scala,
+ImageRandomCrop.scala, ImageHFlip.scala, ImageBrightness.scala,
+ImageHue.scala, ImageSaturation.scala, ImageChannelNormalize.scala,
+ImagePixelNormalizer.scala, ImageChannelOrder.scala,
+ImageMatToTensor.scala, ImageSetToSample.scala,
+ImageRandomPreprocessing.scala).
+
+Host-side PIL/numpy instead of OpenCV JNI; images travel as float32
+HWC arrays (NHWC is the TPU-friendly layout XLA convolutions prefer --
+``ImageMatToTensor(format='NCHW')`` exists for torch-import parity).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ImageFeature:
+    """One image record (ref: ImageFeature keys image/label/uri)."""
+
+    def __init__(self, image: np.ndarray, label: Optional[int] = None,
+                 uri: Optional[str] = None):
+        self.image = np.asarray(image, np.float32)
+        self.label = label
+        self.uri = uri
+        self.sample: Optional[np.ndarray] = None
+
+
+class ImageProcessing:
+    """Per-image op; compose via ImageSet.transform chains
+    (ref: ImageProcessing.scala)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        feature.image = self.apply_image(feature.image)
+        return feature
+
+    def apply_image(self, img: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def __call__(self, feature: ImageFeature) -> ImageFeature:
+        return self.transform(feature)
+
+
+class ImageResize(ImageProcessing):
+    """Bilinear resize to (h, w) (ref: ImageResize.scala)."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.resize_h, self.resize_w = resize_h, resize_w
+
+    def apply_image(self, img):
+        from PIL import Image
+
+        # per-channel float ('F' mode) resize: no 0-255 clip/quantize, so
+        # resizing after normalization keeps the data intact
+        size = (self.resize_w, self.resize_h)
+        chans = [np.asarray(
+            Image.fromarray(np.ascontiguousarray(img[..., c]), mode="F")
+            .resize(size, Image.Resampling.BILINEAR), np.float32)
+            for c in range(img.shape[-1])]
+        return np.stack(chans, axis=-1)
+
+
+class ImageCenterCrop(ImageProcessing):
+    """Crop (crop_h, crop_w) from the center (ref: ImageCenterCrop.scala)."""
+
+    def __init__(self, crop_h: int, crop_w: int):
+        self.crop_h, self.crop_w = crop_h, crop_w
+
+    def apply_image(self, img):
+        h, w = img.shape[:2]
+        top = max(0, (h - self.crop_h) // 2)
+        left = max(0, (w - self.crop_w) // 2)
+        return img[top:top + self.crop_h, left:left + self.crop_w]
+
+
+class ImageRandomCrop(ImageProcessing):
+    """Crop (crop_h, crop_w) at a uniform random offset
+    (ref: ImageRandomCrop.scala)."""
+
+    def __init__(self, crop_h: int, crop_w: int, seed: Optional[int] = None):
+        self.crop_h, self.crop_w = crop_h, crop_w
+        self._rng = np.random.RandomState(seed)
+
+    def apply_image(self, img):
+        h, w = img.shape[:2]
+        top = self._rng.randint(0, max(1, h - self.crop_h + 1))
+        left = self._rng.randint(0, max(1, w - self.crop_w + 1))
+        return img[top:top + self.crop_h, left:left + self.crop_w]
+
+
+class ImageHFlip(ImageProcessing):
+    """Horizontal mirror (ref: ImageHFlip.scala)."""
+
+    def apply_image(self, img):
+        return img[:, ::-1]
+
+
+class ImageBrightness(ImageProcessing):
+    """Add a uniform random delta in [delta_low, delta_high]
+    (ref: ImageBrightness.scala)."""
+
+    def __init__(self, delta_low: float, delta_high: float,
+                 seed: Optional[int] = None):
+        self.delta_low, self.delta_high = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def apply_image(self, img):
+        delta = self._rng.uniform(self.delta_low, self.delta_high)
+        return np.clip(img + delta, 0.0, 255.0)
+
+
+def _rgb_to_hsv(img):
+    import colorsys  # noqa: F401  (documenting the formula source)
+
+    r, g, b = img[..., 0] / 255.0, img[..., 1] / 255.0, img[..., 2] / 255.0
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    rc, gc, bc = (maxc - r) / dz, (maxc - g) / dz, (maxc - b) / dz
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(delta == 0, 0.0, (h / 6.0) % 1.0)
+    return np.stack([h, s, v], -1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    conds = [i == k for k in range(6)]
+    r = np.select(conds, [v, q, p, p, t, v])
+    g = np.select(conds, [t, v, v, q, p, p])
+    b = np.select(conds, [p, p, t, v, v, q])
+    return np.stack([r, g, b], -1) * 255.0
+
+
+class ImageHue(ImageProcessing):
+    """Rotate hue by a random delta in degrees (ref: ImageHue.scala)."""
+
+    def __init__(self, delta_low: float, delta_high: float,
+                 seed: Optional[int] = None):
+        self.delta_low, self.delta_high = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def apply_image(self, img):
+        hsv = _rgb_to_hsv(img)
+        delta = self._rng.uniform(self.delta_low, self.delta_high) / 360.0
+        hsv[..., 0] = (hsv[..., 0] + delta) % 1.0
+        return np.clip(_hsv_to_rgb(hsv), 0.0, 255.0)
+
+
+class ImageSaturation(ImageProcessing):
+    """Scale saturation by a random factor (ref: ImageSaturation.scala)."""
+
+    def __init__(self, delta_low: float, delta_high: float,
+                 seed: Optional[int] = None):
+        self.delta_low, self.delta_high = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def apply_image(self, img):
+        hsv = _rgb_to_hsv(img)
+        hsv[..., 1] = np.clip(
+            hsv[..., 1] * self._rng.uniform(self.delta_low,
+                                            self.delta_high), 0.0, 1.0)
+        return np.clip(_hsv_to_rgb(hsv), 0.0, 255.0)
+
+
+class ImageChannelNormalize(ImageProcessing):
+    """(x - mean) / std per channel (ref: ImageChannelNormalize.scala)."""
+
+    def __init__(self, mean_r: float, mean_g: float, mean_b: float,
+                 std_r: float = 1.0, std_g: float = 1.0,
+                 std_b: float = 1.0):
+        self.mean = np.asarray([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.asarray([std_r, std_g, std_b], np.float32)
+
+    def apply_image(self, img):
+        return (img - self.mean) / self.std
+
+
+class ImagePixelNormalizer(ImageProcessing):
+    """Subtract a per-pixel mean image (ref: ImagePixelNormalizer.scala)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def apply_image(self, img):
+        return img - self.means
+
+
+class ImageChannelOrder(ImageProcessing):
+    """RGB <-> BGR channel swap (ref: ImageChannelOrder.scala)."""
+
+    def apply_image(self, img):
+        return img[..., ::-1]
+
+
+class ImageMatToTensor(ImageProcessing):
+    """Fix the final layout: 'NHWC' (TPU-native) or 'NCHW'
+    (torch-import parity) (ref: ImageMatToTensor.scala format arg)."""
+
+    def __init__(self, format: str = "NHWC"):  # noqa: A002
+        if format not in ("NHWC", "NCHW"):
+            raise ValueError("format must be NHWC or NCHW")
+        self.format = format
+
+    def apply_image(self, img):
+        if self.format == "NCHW":
+            return np.transpose(img, (2, 0, 1))
+        return img
+
+
+class ImageSetToSample(ImageProcessing):
+    """Terminal stage: freeze the current image as the sample array
+    (ref: ImageSetToSample.scala)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        feature.sample = np.asarray(feature.image, np.float32)
+        return feature
+
+    def apply_image(self, img):
+        return img
+
+
+class ImageRandomPreprocessing(ImageProcessing):
+    """Apply an op with probability p (ref: ImageRandomPreprocessing.scala)."""
+
+    def __init__(self, op: ImageProcessing, prob: float,
+                 seed: Optional[int] = None):
+        self.op = op
+        self.prob = prob
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        if self._rng.uniform() < self.prob:
+            return self.op.transform(feature)
+        return feature
+
+    def apply_image(self, img):
+        if self._rng.uniform() < self.prob:
+            return self.op.apply_image(img)
+        return img
+
+
+class ChainedImageProcessing(ImageProcessing):
+    """Left-to-right composition (``a >> b`` on ops would shadow
+    Preprocessing; ImageSet.transform chains instead)."""
+
+    def __init__(self, ops: Sequence[ImageProcessing]):
+        self.ops = list(ops)
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        for op in self.ops:
+            feature = op.transform(feature)
+        return feature
+
+    def apply_image(self, img):
+        f = ImageFeature(img)
+        return self.transform(f).image
+
+
+class ImageSet:
+    """A collection of images flowing through the op chain
+    (ref: ImageSet.scala; python pyzoo/zoo/feature/image/imageset.py)."""
+
+    def __init__(self, features: Sequence[ImageFeature]):
+        self.features: List[ImageFeature] = list(features)
+
+    @classmethod
+    def from_arrays(cls, images: np.ndarray,
+                    labels: Optional[Sequence[int]] = None) -> "ImageSet":
+        labels = labels if labels is not None else [None] * len(images)
+        return cls([ImageFeature(im, la) for im, la in zip(images, labels)])
+
+    @classmethod
+    def read(cls, folder: str) -> "ImageSet":
+        """Read a class-per-subfolder image directory
+        (ref: ImageSet.read; NNImageReader)."""
+        from PIL import Image
+
+        feats = []
+        classes = sorted(d for d in os.listdir(folder)
+                         if os.path.isdir(os.path.join(folder, d)))
+        label_of = {c: i for i, c in enumerate(classes)}
+        for c in classes or [""]:
+            sub = os.path.join(folder, c)
+            for name in sorted(os.listdir(sub)):
+                path = os.path.join(sub, name)
+                if not os.path.isfile(path):
+                    continue
+                img = np.asarray(Image.open(path).convert("RGB"),
+                                 np.float32)
+                feats.append(ImageFeature(img, label_of.get(c), uri=path))
+        return cls(feats)
+
+    def transform(self, *ops: ImageProcessing) -> "ImageSet":
+        chain = ChainedImageProcessing(ops) if len(ops) > 1 else ops[0]
+        for f in self.features:
+            chain.transform(f)
+        return self
+
+    def get_images(self) -> List[np.ndarray]:
+        return [f.image for f in self.features]
+
+    def get_labels(self) -> List[Optional[int]]:
+        return [f.label for f in self.features]
+
+    def to_arrays(self) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        samples = [f.sample if f.sample is not None else f.image
+                   for f in self.features]
+        x = np.stack(samples)
+        labels = self.get_labels()
+        y = (np.asarray(labels, np.int32)
+             if all(l is not None for l in labels) else None)
+        return x, y
+
+    def to_dataset(self):
+        from analytics_zoo_tpu.data.dataset import ZooDataset
+
+        x, y = self.to_arrays()
+        return ZooDataset.from_ndarrays(x, y)
+
+    def __len__(self) -> int:
+        return len(self.features)

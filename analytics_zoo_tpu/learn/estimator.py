@@ -394,9 +394,11 @@ class Estimator:
                     len(failures), retry_times, e)
                 if not can_retry:
                     raise
-                # the restored model's loss is unknown until the next log
-                # step; a stale pre-crash value would misfire MinLoss
+                # the restored model's loss/score are unknown until the
+                # next log step / validation; stale pre-crash values
+                # would misfire MinLoss/MaxScore
                 state.loss = None
+                state.score = None
                 self._restore(checkpoint_dir)
         return history
 

@@ -1,0 +1,24 @@
+"""NNFrames: DataFrame-native fit/transform pipeline API.
+
+The analog of the reference's Spark-ML integration
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/pipeline/nnframes/NNEstimator.scala:198-505,
+NNModel :628-750, NNClassifier.scala; python surface
+pyzoo/zoo/pipeline/nnframes/nn_classifier.py:140-620). Spark DataFrames
+become pandas DataFrames; the Spark-ML Estimator/Transformer contract
+(``fit(df) -> model``, ``model.transform(df) -> df``) is preserved, and
+training funnels into the one SPMD ``learn.Estimator`` instead of
+InternalDistriOptimizer.
+"""
+
+from analytics_zoo_tpu.nnframes.preprocessing import (
+    ArrayToTensor, ChainedPreprocessing, FeatureLabelPreprocessing,
+    Preprocessing, ScalarToTensor, SeqToTensor, TensorToSample)
+from analytics_zoo_tpu.nnframes.nn_estimator import (
+    NNClassifier, NNClassifierModel, NNEstimator, NNModel)
+
+__all__ = [
+    "Preprocessing", "ChainedPreprocessing", "ScalarToTensor",
+    "SeqToTensor", "ArrayToTensor", "FeatureLabelPreprocessing",
+    "TensorToSample", "NNEstimator", "NNModel", "NNClassifier",
+    "NNClassifierModel",
+]

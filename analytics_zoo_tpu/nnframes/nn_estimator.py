@@ -1,0 +1,277 @@
+"""NNEstimator / NNModel / NNClassifier over pandas DataFrames.
+
+The Spark-ML Estimator/Transformer contract re-hosted on pandas
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/pipeline/nnframes/NNEstimator.scala:198-505
+``internalFit`` builds a FeatureSet from DataFrame rows through
+Preprocessing chains and runs InternalDistriOptimizer; ``NNModel``
+broadcasts the model for ``transform`` :628-750; classifier sugar in
+NNClassifier.scala and pyzoo .../nnframes/nn_classifier.py:140-620).
+
+TPU-first collapse: rows -> numpy via the Preprocessing chain once, then
+one jitted SPMD ``learn.Estimator`` step trains over the mesh; transform
+is a sharded ``predict`` appended back as a DataFrame column.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.common.triggers import EveryEpoch, Trigger
+from analytics_zoo_tpu.nnframes.preprocessing import (
+    FeatureLabelPreprocessing, Preprocessing)
+
+ColSpec = Union[str, Sequence[str]]
+
+
+def _extract(df, cols: ColSpec, chain: Optional[Preprocessing],
+             dtype=None):
+    """DataFrame columns -> stacked ndarray (or tuple for multi-input)."""
+
+    def one(col):
+        values = df[col].tolist()
+        if chain is not None:
+            return chain.apply_column(values)
+        arr = np.asarray(
+            [np.asarray(v) for v in values])
+        return arr.astype(dtype) if dtype is not None else arr
+
+    if isinstance(cols, str):
+        return one(cols)
+    out = tuple(one(c) for c in cols)
+    return out[0] if len(out) == 1 else out
+
+
+class NNEstimator:
+    """``fit(df) -> NNModel`` (ref: NNEstimator.scala:198-505).
+
+    Args:
+      model: a KerasNet (``keras.Sequential``/``Model``) or a flax module.
+      criterion: loss name or ``fn(preds, labels)``.
+      feature_preprocessing / label_preprocessing: per-row
+        ``Preprocessing`` chains, or one ``FeatureLabelPreprocessing``
+        passed as ``feature_preprocessing``.
+    """
+
+    def __init__(self, model, criterion="mse",
+                 feature_preprocessing: Optional[Preprocessing] = None,
+                 label_preprocessing: Optional[Preprocessing] = None):
+        if isinstance(feature_preprocessing, FeatureLabelPreprocessing):
+            label_preprocessing = feature_preprocessing.label_preprocessing
+            feature_preprocessing = \
+                feature_preprocessing.feature_preprocessing
+        self.model = model
+        self.criterion = criterion
+        self.feature_preprocessing = feature_preprocessing
+        self.label_preprocessing = label_preprocessing
+        self.features_col: ColSpec = "features"
+        self.label_col = "label"
+        self.prediction_col = "prediction"
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.optim_method: Any = "adam"
+        self.clip_norm: Optional[float] = None
+        self.clip_value: Optional[float] = None
+        self.validation_df = None
+        self.validation_trigger: Optional[Trigger] = None
+        self.validation_batch_size: Optional[int] = None
+        self.checkpoint_path: Optional[str] = None
+        self.checkpoint_trigger: Optional[Trigger] = None
+        self.log_dir: Optional[str] = None
+        self._label_dtype = None
+
+    # fluent setters (reference camelCase API parity,
+    # nn_classifier.py:229-443)
+    def setFeaturesCol(self, col: ColSpec):
+        self.features_col = col
+        return self
+
+    def setLabelCol(self, col: str):
+        self.label_col = col
+        return self
+
+    def setPredictionCol(self, col: str):
+        self.prediction_col = col
+        return self
+
+    def setBatchSize(self, v: int):
+        self.batch_size = int(v)
+        return self
+
+    def setMaxEpoch(self, v: int):
+        self.max_epoch = int(v)
+        return self
+
+    def setLearningRate(self, lr: float):
+        from analytics_zoo_tpu.learn.optim import Adam
+
+        self.optim_method = Adam(lr=lr)
+        return self
+
+    def setOptimMethod(self, method):
+        self.optim_method = method
+        return self
+
+    def setGradientClippingByL2Norm(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+        return self
+
+    def setConstantGradientClipping(self, min_v: float, max_v: float):
+        if abs(min_v) != abs(max_v):
+            raise ValueError("constant clipping is symmetric: pass "
+                             "(-v, v)")
+        self.clip_value = float(max_v)
+        return self
+
+    def clearGradientClipping(self):
+        self.clip_norm = self.clip_value = None
+        return self
+
+    def setValidation(self, trigger: Trigger, val_df,
+                      batch_size: Optional[int] = None):
+        self.validation_trigger = trigger
+        self.validation_df = val_df
+        self.validation_batch_size = batch_size
+        return self
+
+    def setCheckpoint(self, path: str, trigger: Optional[Trigger] = None):
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger or EveryEpoch()
+        return self
+
+    def setTrainSummary(self, log_dir: str):
+        self.log_dir = log_dir
+        return self
+
+    # --------------------------------------------------------------- fit --
+    def _module(self):
+        return (self.model.module if hasattr(self.model, "module")
+                else self.model)
+
+    def _make_estimator(self):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        return Estimator(self._module(), loss=self.criterion,
+                         optimizer=self.optim_method,
+                         clip_norm=self.clip_norm,
+                         clip_value=self.clip_value)
+
+    def _dataset(self, df):
+        x = _extract(df, self.features_col, self.feature_preprocessing,
+                     np.float32)
+        y = _extract(df, self.label_col, self.label_preprocessing,
+                     self._label_dtype or np.float32)
+        return x, y
+
+    def fit(self, df) -> "NNModel":
+        estimator = self._make_estimator()
+        x, y = self._dataset(df)
+        val = (self._dataset(self.validation_df)
+               if self.validation_df is not None else None)
+        estimator.fit(
+            (x, y), batch_size=self.batch_size, epochs=self.max_epoch,
+            validation_data=val,
+            validation_trigger=self.validation_trigger,
+            checkpoint_dir=self.checkpoint_path,
+            checkpoint_trigger=self.checkpoint_trigger,
+            log_dir=self.log_dir)
+        return self._create_model(estimator)
+
+    def _create_model(self, estimator) -> "NNModel":
+        return NNModel(self.model, estimator=estimator,
+                       feature_preprocessing=self.feature_preprocessing,
+                       features_col=self.features_col,
+                       prediction_col=self.prediction_col,
+                       batch_size=self.batch_size)
+
+
+class NNModel:
+    """DataFrame transformer carrying a trained model
+    (ref: NNModel, NNEstimator.scala:628-750)."""
+
+    def __init__(self, model, estimator=None,
+                 feature_preprocessing: Optional[Preprocessing] = None,
+                 features_col: ColSpec = "features",
+                 prediction_col: str = "prediction",
+                 batch_size: int = 32):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        self.model = model
+        module = (model.module if hasattr(model, "module") else model)
+        self.estimator = estimator or Estimator(module)
+        self.feature_preprocessing = feature_preprocessing
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+        self.batch_size = batch_size
+
+    def setFeaturesCol(self, col: ColSpec):
+        self.features_col = col
+        return self
+
+    def setPredictionCol(self, col: str):
+        self.prediction_col = col
+        return self
+
+    def setBatchSize(self, v: int):
+        self.batch_size = int(v)
+        return self
+
+    def _predict_array(self, df) -> np.ndarray:
+        x = _extract(df, self.features_col, self.feature_preprocessing,
+                     np.float32)
+        return np.asarray(
+            self.estimator.predict(x, batch_size=self.batch_size))
+
+    def _post(self, preds: np.ndarray) -> List[Any]:
+        # [N] rows stay scalar; [N, ...] rows become per-row arrays --
+        # the pandas analog of Spark's Vector prediction column
+        if preds.ndim == 1:
+            return list(preds)
+        return [row for row in preds]
+
+    def transform(self, df):
+        out = df.copy()
+        out[self.prediction_col] = self._post(self._predict_array(df))
+        return out
+
+    def save(self, ckpt_dir: str) -> None:
+        self.estimator.save(ckpt_dir)
+
+    def load_weights(self, ckpt_dir: str) -> "NNModel":
+        self.estimator.load(ckpt_dir)
+        return self
+
+
+class NNClassifier(NNEstimator):
+    """Classification sugar: integer label column, cross-entropy default
+    (ref: NNClassifier.scala; nn_classifier.py:543-589)."""
+
+    def __init__(self, model, criterion="sparse_categorical_crossentropy",
+                 feature_preprocessing: Optional[Preprocessing] = None):
+        super().__init__(model, criterion=criterion,
+                         feature_preprocessing=feature_preprocessing)
+        self._label_dtype = np.int32
+
+    def _create_model(self, estimator) -> "NNClassifierModel":
+        return NNClassifierModel(
+            self.model, estimator=estimator,
+            feature_preprocessing=self.feature_preprocessing,
+            features_col=self.features_col,
+            prediction_col=self.prediction_col,
+            batch_size=self.batch_size)
+
+
+class NNClassifierModel(NNModel):
+    """Transformer emitting argmax class ids
+    (ref: NNClassifierModel, nn_classifier.py:590-614)."""
+
+    def _post(self, preds: np.ndarray) -> List[Any]:
+        # single-output (sigmoid/probability) models -> 0.5 threshold,
+        # the reference's HasThreshold default (nn_classifier.py:107-139);
+        # multi-output -> argmax class id
+        if preds.ndim == 2 and preds.shape[-1] == 1:
+            preds = preds[:, 0]
+        if preds.ndim == 1:
+            return list((preds > 0.5).astype(np.int64))
+        return list(np.argmax(preds, axis=-1).astype(np.int64))

@@ -1,0 +1,121 @@
+"""Composable per-row Preprocessing chains.
+
+The analog of the reference's ``Preprocessing[A, B]`` transformer algebra
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/feature/common/Preprocessing.scala;
+python wrappers pyzoo/zoo/feature/common.py:94-238): small pure functions
+over one row's value, composed with ``>>`` (the reference's ``->``), and
+vectorized over a DataFrame column by ``apply_column``. The terminal
+to-Sample/to-MiniBatch stages of the reference collapse away -- chains
+here produce numpy rows that ``ZooDataset`` batches and shards.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+
+class Preprocessing:
+    """One per-row transform step; compose with ``a >> b``."""
+
+    def apply(self, value: Any) -> Any:
+        raise NotImplementedError
+
+    def __call__(self, value: Any) -> Any:
+        return self.apply(value)
+
+    def __rshift__(self, other: "Preprocessing") -> "ChainedPreprocessing":
+        return ChainedPreprocessing([self, other])
+
+    def apply_column(self, column: Sequence[Any]) -> np.ndarray:
+        """Apply to every row of a column and stack to [N, ...]."""
+        rows = [np.asarray(self.apply(v)) for v in column]
+        return np.stack(rows)
+
+
+class ChainedPreprocessing(Preprocessing):
+    """Left-to-right composition (ref: ChainedPreprocessing,
+    feature/common.py:122-134)."""
+
+    def __init__(self, stages: Sequence[Preprocessing]):
+        flat = []
+        for s in stages:
+            if not isinstance(s, Preprocessing):
+                raise TypeError(f"{s!r} is not a Preprocessing")
+            if isinstance(s, ChainedPreprocessing):
+                flat.extend(s.stages)
+            else:
+                flat.append(s)
+        self.stages = flat
+
+    def apply(self, value: Any) -> Any:
+        for s in self.stages:
+            value = s.apply(value)
+        return value
+
+
+class Lambda(Preprocessing):
+    """Wrap an arbitrary per-row function into the chain algebra."""
+
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+
+    def apply(self, value: Any) -> Any:
+        return self.fn(value)
+
+
+class ScalarToTensor(Preprocessing):
+    """Python/numpy scalar -> float32 scalar array (ref: ScalarToTensor,
+    feature/common.py:136-144)."""
+
+    def __init__(self, dtype: str = "float32"):
+        self.dtype = np.dtype(dtype)
+
+    def apply(self, value: Any):
+        return np.asarray(value, self.dtype)
+
+
+class SeqToTensor(Preprocessing):
+    """Sequence/array -> array, optionally reshaped to ``size``
+    (ref: SeqToTensor, feature/common.py:145-154)."""
+
+    def __init__(self, size: Optional[Sequence[int]] = None,
+                 dtype: str = "float32"):
+        self.size = tuple(size) if size is not None else None
+        self.dtype = np.dtype(dtype)
+
+    def apply(self, value: Any):
+        arr = np.asarray(value, self.dtype)
+        if self.size is not None:
+            arr = arr.reshape(self.size)
+        return arr
+
+
+class ArrayToTensor(SeqToTensor):
+    """Alias of SeqToTensor for numpy-array columns (ref: ArrayToTensor,
+    feature/common.py:165-174)."""
+
+
+class TensorToSample(Preprocessing):
+    """Identity terminal stage kept for reference API parity
+    (ref: TensorToSample, feature/common.py:200-208): samples here are
+    just numpy rows."""
+
+    def apply(self, value: Any):
+        return value
+
+
+class FeatureLabelPreprocessing(Preprocessing):
+    """Pairs a feature chain with a label chain over (feature, label)
+    rows (ref: FeatureLabelPreprocessing, feature/common.py:186-199)."""
+
+    def __init__(self, feature_preprocessing: Preprocessing,
+                 label_preprocessing: Preprocessing):
+        self.feature_preprocessing = feature_preprocessing
+        self.label_preprocessing = label_preprocessing
+
+    def apply(self, value: Any):
+        feature, label = value
+        return (self.feature_preprocessing.apply(feature),
+                self.label_preprocessing.apply(label))

@@ -62,6 +62,10 @@ def dot_product_attention(q, k, v, mask=None, key_padding_mask=None,
     d = q.shape[-1]
     l, lk = q.shape[2], k.shape[2]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    if causal and l > lk:
+        # with the bottom-right-aligned diagonal the first lq-lk rows
+        # attend to nothing; every backend would return garbage for them
+        raise ValueError("causal attention requires len(q) <= len(kv)")
 
     flash_ok = (mask is None and dropout_rate == 0.0
                 and _platform(q) == "tpu"

@@ -1,0 +1,245 @@
+"""Feature layer tests: TextSet chain driving zoo text models from raw
+strings, Relations pair generation, and the image op library."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature import (
+    ImageBrightness, ImageCenterCrop, ImageChannelNormalize,
+    ImageChannelOrder, ImageHFlip, ImageHue, ImageMatToTensor,
+    ImageRandomCrop, ImageRandomPreprocessing, ImageResize,
+    ImageSaturation, ImageSet, ImageSetToSample, Relation, SequenceShaper,
+    TextSet)
+from analytics_zoo_tpu.feature.text import (
+    from_relation_lists, from_relation_pairs)
+
+
+def corpus(n_per_class=40, seed=0):
+    rng = np.random.RandomState(seed)
+    pos_words = ["great", "excellent", "wonderful", "loved", "superb"]
+    neg_words = ["terrible", "awful", "boring", "hated", "poor"]
+    fill = ["the", "movie", "was", "plot", "acting", "scene", "Film!"]
+    texts, labels = [], []
+    for label, words in [(1, pos_words), (0, neg_words)]:
+        for _ in range(n_per_class):
+            toks = [words[rng.randint(len(words))] for _ in range(3)]
+            toks += [fill[rng.randint(len(fill))] for _ in range(5)]
+            rng.shuffle(toks)
+            texts.append(" ".join(toks))
+            labels.append(label)
+    return texts, labels
+
+
+class TestTextSet:
+    def test_chain_produces_arrays(self):
+        texts, labels = corpus(8)
+        ts = (TextSet.from_texts(texts, labels)
+              .tokenize().normalize().word2idx()
+              .shape_sequence(len=12).generate_sample())
+        x, y = ts.to_arrays()
+        assert x.shape == (16, 12) and x.dtype == np.int32
+        assert y.shape == (16,)
+        assert ts.get_word_index() is not None
+        # normalization lower-cased and stripped punctuation
+        assert "film" in ts.get_word_index()
+        assert "Film!" not in ts.get_word_index()
+
+    def test_word2idx_remove_top_and_cap(self):
+        texts = ["a a a a b b b c c d"]
+        ts = TextSet.from_texts(texts).tokenize()
+        ts.word2idx(remove_topN=1, max_words_num=2)
+        vocab = ts.get_word_index()
+        assert "a" not in vocab and len(vocab) == 2
+        assert set(vocab.values()) == {1, 2}
+
+    def test_sequence_shaper_modes(self):
+        from analytics_zoo_tpu.feature.text import TextFeature
+
+        f = TextFeature("x")
+        f.indices = np.arange(1, 7, dtype=np.int32)
+        pre = SequenceShaper(len=3, trunc_mode="pre").transform(f).indices
+        np.testing.assert_array_equal(pre, [4, 5, 6])
+        f.indices = np.arange(1, 7, dtype=np.int32)
+        post = SequenceShaper(len=3, trunc_mode="post").transform(f).indices
+        np.testing.assert_array_equal(post, [1, 2, 3])
+        f.indices = np.asarray([1, 2], np.int32)
+        padded = SequenceShaper(len=4).transform(f).indices
+        np.testing.assert_array_equal(padded, [1, 2, 0, 0])
+
+    def test_word_index_save_load_roundtrip(self, tmp_path):
+        texts, labels = corpus(4)
+        ts = TextSet.from_texts(texts, labels).tokenize().word2idx()
+        p = str(tmp_path / "vocab.json")
+        ts.save_word_index(p)
+        ts2 = TextSet.from_texts(["great movie"]).load_word_index(p)
+        assert ts2.get_word_index() == ts.get_word_index()
+
+    def test_random_split(self):
+        texts, labels = corpus(10)
+        ts = TextSet.from_texts(texts, labels)
+        a, b = ts.random_split(0.8)
+        assert len(a) == 16 and len(b) == 4
+
+    def test_text_classifier_from_raw_strings(self):
+        """The reference's TextClassification workflow: raw text ->
+        TextSet chain -> model fit/predict."""
+        from analytics_zoo_tpu.models import TextClassifier
+
+        texts, labels = corpus(40)
+        ts = (TextSet.from_texts(texts, labels)
+              .tokenize().normalize().word2idx()
+              .shape_sequence(len=10).generate_sample())
+        x, y = ts.to_arrays()
+        vocab = len(ts.get_word_index())
+        model = TextClassifier(class_num=2, vocab=vocab, embed_dim=16,
+                               sequence_length=10)
+        model.fit((x, y), batch_size=16, epochs=6)
+        res = model.evaluate((x, y), batch_size=16)
+        assert res["accuracy"] > 0.85
+
+
+class TestRelations:
+    def make_corpora(self, L1=4, L2=6):
+        q = (TextSet.from_texts(["what is jax", "how to shard"])
+             .tokenize().word2idx().shape_sequence(len=L1)
+             .generate_sample())
+        q.features[0].uri, q.features[1].uri = "q1", "q2"
+        a = (TextSet.from_texts(["jax is an array library",
+                                 "sharding splits arrays",
+                                 "bananas are yellow"])
+             .tokenize().word2idx().shape_sequence(len=L2)
+             .generate_sample())
+        for f, uri in zip(a.features, ["a1", "a2", "a3"]):
+            f.uri = uri
+        return q, a
+
+    def test_from_relation_pairs_shapes(self):
+        q, a = self.make_corpora()
+        rels = [Relation("q1", "a1", 1), Relation("q1", "a3", 0),
+                Relation("q2", "a2", 1), Relation("q2", "a3", 0)]
+        pairs = from_relation_pairs(rels, q, a)
+        assert pairs.shape == (2, 2, 10) and pairs.dtype == np.int32
+
+    def test_from_relation_lists_groups(self):
+        q, a = self.make_corpora()
+        rels = [Relation("q1", "a1", 1), Relation("q1", "a3", 0),
+                Relation("q2", "a2", 1)]
+        lists = from_relation_lists(rels, q, a)
+        assert len(lists) == 2
+        x, y = lists[0]
+        assert x.shape == (2, 10) and list(y) == [1, 0]
+
+    def test_knrm_trains_on_relation_pairs(self):
+        from analytics_zoo_tpu.models import KNRM
+
+        q, a = self.make_corpora()
+        rels = [Relation("q1", "a1", 1), Relation("q1", "a3", 0),
+                Relation("q2", "a2", 1), Relation("q2", "a3", 0)]
+        pairs = from_relation_pairs(rels, q, a)
+        pairs = np.tile(pairs, (8, 1, 1))  # enough rows to batch
+        vocab = max(len(q.get_word_index()), len(a.get_word_index()))
+        model = KNRM(text1_length=4, text2_length=6, vocab=vocab,
+                     embed_dim=8)
+        hist = model.fit(pairs, batch_size=8, epochs=3)
+        assert hist[-1]["loss"] <= hist[0]["loss"] + 1e-3
+
+
+class TestImageOps:
+    def img(self, h=32, w=48, seed=0):
+        return np.random.RandomState(seed).uniform(
+            0, 255, (h, w, 3)).astype(np.float32)
+
+    def test_resize(self):
+        out = ImageResize(16, 24).apply_image(self.img())
+        assert out.shape == (16, 24, 3)
+
+    def test_resize_preserves_normalized_floats(self):
+        # resize after ChannelNormalize must not clip/quantize to 0-255
+        im = (self.img() - 127.5) / 127.5
+        out = ImageResize(16, 24).apply_image(im)
+        assert out.min() < -0.5 and out.max() > 0.5
+        assert abs(out.mean() - im.mean()) < 0.05
+
+    def test_center_crop(self):
+        out = ImageCenterCrop(16, 16).apply_image(self.img())
+        assert out.shape == (16, 16, 3)
+
+    def test_random_crop(self):
+        out = ImageRandomCrop(16, 16, seed=0).apply_image(self.img())
+        assert out.shape == (16, 16, 3)
+
+    def test_hflip(self):
+        im = self.img()
+        out = ImageHFlip().apply_image(im)
+        np.testing.assert_allclose(out[:, 0], im[:, -1])
+
+    def test_brightness_bounds(self):
+        out = ImageBrightness(10, 10, seed=0).apply_image(self.img())
+        assert out.max() <= 255.0 and out.min() >= 0.0
+
+    def test_hue_saturation_preserve_shape_and_range(self):
+        im = self.img()
+        for op in (ImageHue(-18, 18, seed=0),
+                   ImageSaturation(0.5, 1.5, seed=0)):
+            out = op.apply_image(im)
+            assert out.shape == im.shape
+            assert out.min() >= 0.0 and out.max() <= 255.0
+
+    def test_hue_zero_delta_is_identity(self):
+        im = self.img()
+        out = ImageHue(0, 0).apply_image(im)
+        np.testing.assert_allclose(out, im, atol=1e-2)
+
+    def test_channel_normalize(self):
+        im = self.img()
+        out = ImageChannelNormalize(10, 20, 30, 2, 2, 2).apply_image(im)
+        np.testing.assert_allclose(out[..., 0], (im[..., 0] - 10) / 2,
+                                   rtol=1e-6)
+
+    def test_channel_order(self):
+        im = self.img()
+        out = ImageChannelOrder().apply_image(im)
+        np.testing.assert_allclose(out[..., 0], im[..., 2])
+
+    def test_mat_to_tensor_nchw(self):
+        out = ImageMatToTensor("NCHW").apply_image(self.img())
+        assert out.shape == (3, 32, 48)
+
+    def test_random_preprocessing_prob(self):
+        im = self.img()
+        never = ImageRandomPreprocessing(ImageHFlip(), 0.0, seed=0)
+        np.testing.assert_allclose(never.apply_image(im), im)
+        always = ImageRandomPreprocessing(ImageHFlip(), 1.0, seed=0)
+        np.testing.assert_allclose(always.apply_image(im),
+                                   im[:, ::-1])
+
+    def test_imageset_chain_to_dataset(self):
+        rng = np.random.RandomState(0)
+        images = rng.uniform(0, 255, (10, 40, 40, 3)).astype(np.float32)
+        labels = rng.randint(0, 2, 10)
+        iset = ImageSet.from_arrays(images, labels)
+        iset.transform(
+            ImageResize(32, 32),
+            ImageCenterCrop(28, 28),
+            ImageChannelNormalize(127.5, 127.5, 127.5, 127.5, 127.5,
+                                  127.5),
+            ImageSetToSample())
+        x, y = iset.to_arrays()
+        assert x.shape == (10, 28, 28, 3)
+        assert y.shape == (10,)
+        ds = iset.to_dataset()
+        assert ds.num_samples == 10
+
+    def test_imageset_read_folder(self, tmp_path):
+        from PIL import Image
+
+        for cls in ("cats", "dogs"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                arr = np.random.RandomState(i).randint(
+                    0, 255, (8, 8, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.png")
+        iset = ImageSet.read(str(tmp_path))
+        assert len(iset) == 4
+        assert sorted(set(iset.get_labels())) == [0, 1]

@@ -1,0 +1,155 @@
+"""NNFrames tests: Preprocessing chains + NNEstimator/NNClassifier
+fit->transform over pandas DataFrames (the dogs-vs-cats-style tabular
+workflow of ref north-star #1, NNEstimator path)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras.layers import Dense
+from analytics_zoo_tpu.nnframes import (
+    ArrayToTensor, ChainedPreprocessing, FeatureLabelPreprocessing,
+    NNClassifier, NNClassifierModel, NNEstimator, NNModel,
+    ScalarToTensor, SeqToTensor)
+from analytics_zoo_tpu.nnframes.preprocessing import Lambda
+
+
+class TestPreprocessing:
+    def test_seq_to_tensor_and_chain(self):
+        chain = SeqToTensor([4]) >> Lambda(lambda a: a * 2.0)
+        out = chain.apply([1, 2, 3, 4])
+        np.testing.assert_allclose(out, [2, 4, 6, 8])
+        assert out.dtype == np.float32
+
+    def test_chain_flattens_nested(self):
+        c = (SeqToTensor() >> Lambda(lambda a: a + 1)) >> \
+            Lambda(lambda a: a * 3)
+        assert isinstance(c, ChainedPreprocessing)
+        assert len(c.stages) == 3
+
+    def test_apply_column_stacks(self):
+        col = [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]
+        arr = SeqToTensor([2]).apply_column(col)
+        assert arr.shape == (3, 2)
+
+    def test_scalar_to_tensor(self):
+        assert ScalarToTensor().apply(3).shape == ()
+
+    def test_feature_label_pair(self):
+        fl = FeatureLabelPreprocessing(SeqToTensor([2]),
+                                       ScalarToTensor("int32"))
+        f, l = fl.apply(([1.0, 2.0], 1))
+        assert f.shape == (2,) and l.dtype == np.int32
+
+    def test_chain_rejects_non_preprocessing(self):
+        with pytest.raises(TypeError):
+            ChainedPreprocessing([SeqToTensor(), "nope"])
+
+
+def make_df(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.int64)
+    return pd.DataFrame({
+        "features": [row for row in x],
+        "label": y,
+        "label_f": (y * 2.0 - 1.0).astype(np.float32),
+    })
+
+
+class TestNNEstimator:
+    def test_fit_transform_regression(self):
+        df = make_df()
+        model = Sequential([Dense(16, activation="relu"), Dense(1)])
+        est = (NNEstimator(model, criterion="mse",
+                           feature_preprocessing=SeqToTensor([4]))
+               .setLabelCol("label_f").setBatchSize(64).setMaxEpoch(4)
+               .setLearningRate(1e-2))
+        nn_model = est.fit(df)
+        assert isinstance(nn_model, NNModel)
+        out = nn_model.transform(df)
+        assert "prediction" in out.columns
+        assert len(out) == len(df)
+        # regression should at least correlate with the target sign
+        preds = np.array([np.ravel(p)[0] for p in out["prediction"]])
+        acc = ((preds > 0) == (df["label_f"].values > 0)).mean()
+        assert acc > 0.8
+
+    def test_validation_and_clipping(self, tmp_path):
+        from analytics_zoo_tpu.common.triggers import EveryEpoch
+
+        df = make_df(128)
+        model = Sequential([Dense(8, activation="relu"), Dense(1)])
+        est = (NNEstimator(model, criterion="mse",
+                           feature_preprocessing=SeqToTensor([4]))
+               .setLabelCol("label_f").setBatchSize(64).setMaxEpoch(2)
+               .setGradientClippingByL2Norm(1.0)
+               .setValidation(EveryEpoch(), make_df(64, seed=1))
+               .setCheckpoint(str(tmp_path / "ckpt")))
+        est.fit(df)
+        assert (tmp_path / "ckpt" / "latest").exists()
+
+    def test_feature_label_preprocessing_single_arg(self):
+        df = make_df(128)
+        model = Sequential([Dense(8, activation="relu"), Dense(1)])
+        fl = FeatureLabelPreprocessing(SeqToTensor([4]),
+                                       ScalarToTensor())
+        est = (NNEstimator(model, "mse", feature_preprocessing=fl)
+               .setLabelCol("label_f").setBatchSize(64).setMaxEpoch(1))
+        assert est.label_preprocessing is not None
+        est.fit(df)
+
+
+class TestNNClassifier:
+    def test_fit_transform_classification(self):
+        df = make_df()
+        model = Sequential([Dense(16, activation="relu"), Dense(2)])
+        clf = (NNClassifier(model,
+                            feature_preprocessing=ArrayToTensor([4]))
+               .setBatchSize(64).setMaxEpoch(5).setLearningRate(1e-2))
+        nn_model = clf.fit(df)
+        assert isinstance(nn_model, NNClassifierModel)
+        out = nn_model.transform(df)
+        acc = (out["prediction"].values == df["label"].values).mean()
+        assert acc > 0.85
+
+    def test_multi_feature_cols(self):
+        rng = np.random.RandomState(0)
+        n = 128
+        a = rng.randn(n, 2).astype(np.float32)
+        df = pd.DataFrame({"fa": [r for r in a], "label": (
+            a[:, 0] > 0).astype(np.int64)})
+        model = Sequential([Dense(8, activation="relu"), Dense(2)])
+        clf = (NNClassifier(model, feature_preprocessing=SeqToTensor([2]))
+               .setFeaturesCol("fa").setBatchSize(32).setMaxEpoch(3))
+        out = clf.fit(df).transform(df)
+        assert out["prediction"].isin([0, 1]).all()
+
+    def test_binary_single_output_threshold(self):
+        df = make_df()
+        model = Sequential([Dense(8, activation="relu"),
+                            Dense(1, activation="sigmoid")])
+        clf = (NNClassifier(model, criterion="binary_crossentropy",
+                            feature_preprocessing=SeqToTensor([4]))
+               .setBatchSize(64).setMaxEpoch(6).setLearningRate(1e-2))
+        out = clf.fit(df).transform(df)
+        assert set(np.unique(out["prediction"].values)) == {0, 1}
+        acc = (out["prediction"].values == df["label"].values).mean()
+        assert acc > 0.8
+
+    def test_save_load_weights(self, tmp_path):
+        df = make_df(128)
+        model = Sequential([Dense(8, activation="relu"), Dense(2)])
+        clf = (NNClassifier(model, feature_preprocessing=SeqToTensor([4]))
+               .setBatchSize(64).setMaxEpoch(2))
+        m = clf.fit(df)
+        before = m.transform(df)["prediction"].values
+        m.save(str(tmp_path / "m"))
+        m2 = NNModel(model, feature_preprocessing=SeqToTensor([4]))
+        m2.load_weights(str(tmp_path / "m"))
+        m2 = NNClassifierModel(
+            model, estimator=m2.estimator,
+            feature_preprocessing=SeqToTensor([4]))
+        after = m2.transform(df)["prediction"].values
+        np.testing.assert_array_equal(before, after)
