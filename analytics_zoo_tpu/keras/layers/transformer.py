@@ -21,13 +21,35 @@ import numpy as np
 from analytics_zoo_tpu.keras.layers.base import KerasLayer
 from analytics_zoo_tpu.ops.attention import dot_product_attention
 
+_ring_dropout_warned = False
+
+
+def _warn_ring_dropout_once():
+    global _ring_dropout_warned
+    if not _ring_dropout_warned:
+        _ring_dropout_warned = True
+        from analytics_zoo_tpu.common.log import get_logger
+
+        get_logger(__name__).warning(
+            "seq_axis ring attention does not support attention-prob "
+            "dropout; attn_dropout is ignored on this path (hidden "
+            "dropout still applies)")
+
 
 class MultiHeadSelfAttention(nn.Module):
+    """``seq_axis``: name of a mesh axis to shard the sequence over --
+    when set (and the context mesh has that axis with size > 1, no
+    explicit mask, no attention dropout), attention runs as exact ring
+    attention over the axis (``parallel.ring_attention``), giving
+    long-context sequence parallelism inside any model built on this
+    layer. Otherwise dispatches to the flash/jnp kernels."""
+
     hidden_size: int
     n_head: int
     attn_dropout: float = 0.0
     causal: bool = False
     dtype: Any = jnp.float32  # compute dtype; params stay fp32
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None, key_padding_mask=None,
@@ -38,17 +60,48 @@ class MultiHeadSelfAttention(nn.Module):
                        name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        def heads(t):
-            return t.reshape(b, l, self.n_head, hd).transpose(0, 2, 1, 3)
+        out = None
+        if (self.seq_axis is not None and mask is None
+                and key_padding_mask is None):
+            from analytics_zoo_tpu.parallel.mesh import (
+                default_mesh, mesh_axis_size)
+            from analytics_zoo_tpu.parallel.ring_attention import (
+                ring_attention)
 
-        rng = (self.make_rng("dropout")
-               if train and self.attn_dropout > 0 else None)
-        out = dot_product_attention(
-            heads(q), heads(k), heads(v), mask=mask,
-            key_padding_mask=key_padding_mask, causal=self.causal,
-            dropout_rate=self.attn_dropout if train else 0.0,
-            dropout_rng=rng)
-        out = out.transpose(0, 2, 1, 3).reshape(b, l, self.hidden_size)
+            mesh = default_mesh()
+            seq_size = mesh_axis_size(mesh, self.seq_axis)
+            data_size = mesh_axis_size(
+                mesh, "data") if "data" in mesh.axis_names else 1
+            # shard_map preconditions: both sharded dims must divide --
+            # fall back to the dense path like the mask/dropout cases
+            if seq_size > 1 and l % seq_size == 0 and b % data_size == 0:
+                if train and self.attn_dropout > 0:
+                    # ring (like every flash kernel) has no prob-dropout;
+                    # seq_axis is an explicit request for long context,
+                    # so keep the ring and drop this regularizer
+                    _warn_ring_dropout_once()
+                # ring layout [B, L, H, D]; shard_map nests inside the
+                # outer jit and reshards q/k/v along the seq axis
+                out = ring_attention(
+                    q.reshape(b, l, self.n_head, hd),
+                    k.reshape(b, l, self.n_head, hd),
+                    v.reshape(b, l, self.n_head, hd),
+                    mesh, axis_name=self.seq_axis, causal=self.causal,
+                ).reshape(b, l, self.hidden_size)
+        if out is None:
+            def heads(t):
+                return t.reshape(b, l, self.n_head,
+                                 hd).transpose(0, 2, 1, 3)
+
+            rng = (self.make_rng("dropout")
+                   if train and self.attn_dropout > 0 else None)
+            out = dot_product_attention(
+                heads(q), heads(k), heads(v), mask=mask,
+                key_padding_mask=key_padding_mask, causal=self.causal,
+                dropout_rate=self.attn_dropout if train else 0.0,
+                dropout_rng=rng)
+            out = out.transpose(0, 2, 1, 3).reshape(b, l,
+                                                    self.hidden_size)
         return nn.Dense(self.hidden_size, dtype=self.dtype,
                         name="proj")(out)
 
@@ -65,6 +118,7 @@ class TransformerBlock(nn.Module):
     causal: bool = False
     activation: str = "gelu"
     dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None, key_padding_mask=None,
@@ -72,7 +126,8 @@ class TransformerBlock(nn.Module):
         act = jax.nn.gelu if self.activation == "gelu" else jax.nn.relu
         attn = MultiHeadSelfAttention(
             self.hidden_size, self.n_head, attn_dropout=self.attn_dropout,
-            causal=self.causal, dtype=self.dtype, name="attention")(
+            causal=self.causal, dtype=self.dtype,
+            seq_axis=self.seq_axis, name="attention")(
                 x, mask=mask, key_padding_mask=key_padding_mask,
                 train=train)
         attn = nn.Dropout(self.hidden_dropout,
@@ -103,6 +158,7 @@ class TransformerModule(nn.Module):
     attn_dropout: float = 0.1
     output_all_block: bool = False
     dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -121,7 +177,8 @@ class TransformerModule(nn.Module):
                 self.hidden_size, self.n_head, inter,
                 hidden_dropout=self.hidden_dropout,
                 attn_dropout=self.attn_dropout, causal=True,
-                dtype=self.dtype, name=f"block_{i}")(h, train=train)
+                dtype=self.dtype, seq_axis=self.seq_axis,
+                name=f"block_{i}")(h, train=train)
             outs.append(h)
         return tuple(outs) if self.output_all_block else h
 
@@ -145,6 +202,7 @@ class BERTModule(nn.Module):
     hidden_dropout: float = 0.1
     attn_dropout: float = 0.1
     dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -172,7 +230,7 @@ class BERTModule(nn.Module):
                 self.hidden_size, self.n_head, self.intermediate_size,
                 hidden_dropout=self.hidden_dropout,
                 attn_dropout=self.attn_dropout, causal=False,
-                dtype=self.dtype,
+                dtype=self.dtype, seq_axis=self.seq_axis,
                 name=f"encoder_{i}")(h, key_padding_mask=attn_mask,
                                      train=train)
         pooled = jnp.tanh(nn.Dense(self.hidden_size, name="pooler")

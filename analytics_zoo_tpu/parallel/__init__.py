@@ -33,4 +33,7 @@ from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_self_attention,
 )
-from analytics_zoo_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipeline_train_step,
+)

