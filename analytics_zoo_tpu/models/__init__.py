@@ -23,6 +23,7 @@ from analytics_zoo_tpu.models.seq2seq import Seq2seq  # noqa: F401
 from analytics_zoo_tpu.models.anomaly import AnomalyDetector  # noqa: F401
 from analytics_zoo_tpu.models.image import (  # noqa: F401
     ImageClassifier,
+    ObjectDetector,
     ResNet18,
     ResNet50,
 )

@@ -10,3 +10,9 @@ from analytics_zoo_tpu.models.image.classifier import (  # noqa: F401
     ImageClassifier,
 )
 from analytics_zoo_tpu.models.image import detection  # noqa: F401
+from analytics_zoo_tpu.models.image.object_detection import (  # noqa: F401
+    ObjectDetector,
+    SSDModule,
+    generate_anchors,
+    visualize,
+)

@@ -1,0 +1,197 @@
+"""SSD-style object detection: model, anchors, predict pipeline.
+
+The analog of the reference's object-detection family
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/models/image/objectdetection/ --
+``ObjectDetector.loadModel`` + ``Predictor`` load-and-predict pipeline,
+SSD anchors/decode in ``common/BboxUtil.scala``, ``Visualizer.scala``
+box drawing; python surface pyzoo/zoo/models/image/objectdetection.py).
+
+TPU-first shape discipline: one NHWC forward producing every scale's
+class/box heads as static-shape tensors; all dynamic-size work (NMS,
+thresholding) happens host-side in numpy on the decoded outputs --
+XLA never sees a data-dependent shape.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel, register_model
+from analytics_zoo_tpu.models.image.detection import (
+    clip_boxes, decode_boxes, detect_per_class)
+
+
+def generate_anchors(image_size: int, feature_sizes: Sequence[int],
+                     scales: Sequence[float],
+                     aspect_ratios: Sequence[Sequence[float]]
+                     ) -> np.ndarray:
+    """SSD prior boxes [N, 4] (x1, y1, x2, y2 in pixels)
+    (ref: objectdetection SSD prior-box generation in BboxUtil/SSD
+    graph). One anchor per (cell, scale x ratio) on every feature map;
+    an extra geometric-mean scale anchor per cell mirrors SSD's
+    ``extra prior``."""
+    anchors: List[Tuple[float, float, float, float]] = []
+    for fsize, scale, ratios, next_scale in zip(
+            feature_sizes, scales, aspect_ratios,
+            list(scales[1:]) + [1.0]):
+        step = image_size / fsize
+        sizes = [(scale, scale),
+                 (float(np.sqrt(scale * next_scale)),
+                  float(np.sqrt(scale * next_scale)))]
+        for r in ratios:
+            sizes.append((scale * float(np.sqrt(r)),
+                          scale / float(np.sqrt(r))))
+        for i, j in itertools.product(range(fsize), repeat=2):
+            cx = (j + 0.5) * step
+            cy = (i + 0.5) * step
+            for w, h in sizes:
+                pw, ph = w * image_size, h * image_size
+                anchors.append((cx - pw / 2, cy - ph / 2,
+                                cx + pw / 2, cy + ph / 2))
+    return np.asarray(anchors, np.float32)
+
+
+class _ConvBlock(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, (3, 3), strides=(self.stride,
+                                                    self.stride),
+                    use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        return nn.relu(x)
+
+
+class SSDModule(nn.Module):
+    """Small SSD: conv backbone + multi-scale class/box heads.
+
+    Input [B, S, S, 3] -> (class_logits [B, N, C+1], box_deltas [B, N, 4])
+    where N = total anchors over the feature pyramid and column 0 of the
+    class axis is background (the reference's SSD output contract).
+    """
+
+    class_num: int           # foreground classes (background added)
+    image_size: int = 128
+    widths: Sequence[int] = (32, 64, 128)
+    anchors_per_cell: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b = x.shape[0]
+        cls_outs, box_outs = [], []
+        h = x
+        # stem halves twice; each pyramid level halves again
+        h = _ConvBlock(self.widths[0])(h, train=train)
+        h = _ConvBlock(self.widths[0], stride=2)(h, train=train)
+        h = _ConvBlock(self.widths[0], stride=2)(h, train=train)
+        a = self.anchors_per_cell
+        for w in self.widths:
+            h = _ConvBlock(w, stride=2)(h, train=train)
+            cls = nn.Conv(a * (self.class_num + 1), (3, 3),
+                          padding="SAME")(h)
+            box = nn.Conv(a * 4, (3, 3), padding="SAME")(h)
+            cls_outs.append(cls.reshape(b, -1, self.class_num + 1))
+            box_outs.append(box.reshape(b, -1, 4))
+        return (jnp.concatenate(cls_outs, axis=1),
+                jnp.concatenate(box_outs, axis=1))
+
+
+@register_model
+class ObjectDetector(ZooModel):
+    """Load-and-predict SSD pipeline (ref: ObjectDetector.scala +
+    Predictor.scala): ``detect(images)`` returns per-image lists of
+    (class_id, score, [x1, y1, x2, y2]) after decode + per-class NMS."""
+
+    default_loss = None
+    default_optimizer = "adam"
+
+    def __init__(self, class_num: int, image_size: int = 128,
+                 widths: Sequence[int] = (32, 64, 128),
+                 anchors_per_cell: int = 4,
+                 label_map: Optional[Dict[int, str]] = None):
+        self._label_map = dict(label_map or {})
+        if anchors_per_cell < 3:
+            raise ValueError("anchors_per_cell must be >= 3 "
+                             "(2 square priors + aspect ratios)")
+        super().__init__(class_num=class_num, image_size=image_size,
+                         widths=tuple(widths),
+                         anchors_per_cell=anchors_per_cell)
+        # SAME-padded stride-2 convs produce ceil(s/2) grids; mirror
+        # that exactly so anchor count always matches the head outputs
+        s = -(-image_size // 2)   # stem block 1
+        s = -(-s // 2)            # stem block 2
+        feature_sizes = []
+        for _ in widths:
+            s = -(-s // 2)
+            feature_sizes.append(s)
+        n_scales = len(widths)
+        scales = [0.15 + 0.55 * i / max(n_scales - 1, 1)
+                  for i in range(n_scales)]
+        # 2 square priors per cell; remaining slots are aspect ratios
+        ratio_bank = [2.0, 0.5, 3.0, 1.0 / 3.0]
+        ratios = [ratio_bank[:anchors_per_cell - 2]] * n_scales
+        self.anchors = generate_anchors(image_size, feature_sizes,
+                                        scales, ratios)
+
+    def _build_module(self):
+        c = self._config
+        return SSDModule(class_num=c["class_num"],
+                         image_size=c["image_size"],
+                         widths=c["widths"],
+                         anchors_per_cell=c["anchors_per_cell"])
+
+    def _example_input(self):
+        s = self._config["image_size"]
+        return np.zeros((1, s, s, 3), np.float32)
+
+    def detect(self, images: np.ndarray, batch_size: int = 8,
+               score_threshold: float = 0.3, iou_threshold: float = 0.45,
+               top_k: int = 100
+               ) -> List[List[Tuple[int, float, np.ndarray]]]:
+        """Full predict pipeline on [B, S, S, 3] images."""
+        import jax
+
+        cls_logits, box_deltas = self.estimator.predict(
+            np.asarray(images, np.float32), batch_size=batch_size)
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(cls_logits), -1))
+        deltas = np.asarray(box_deltas)
+        size = self._config["image_size"]
+        results = []
+        for b in range(probs.shape[0]):
+            boxes = clip_boxes(decode_boxes(self.anchors, deltas[b]),
+                               size, size)
+            results.append(detect_per_class(
+                boxes, probs[b], score_threshold=score_threshold,
+                iou_threshold=iou_threshold, top_k=top_k))
+        return results
+
+    def label_of(self, class_id: int) -> str:
+        return self._label_map.get(class_id, str(class_id))
+
+
+def visualize(image: np.ndarray,
+              detections: Sequence[Tuple[int, float, np.ndarray]],
+              label_map: Optional[Dict[int, str]] = None) -> np.ndarray:
+    """Draw detection boxes + labels onto an image (ref:
+    objectdetection/visualization/Visualizer.scala). Returns HWC uint8."""
+    from PIL import Image, ImageDraw
+
+    img = Image.fromarray(np.clip(image, 0, 255).astype(np.uint8))
+    draw = ImageDraw.Draw(img)
+    palette = [(255, 64, 64), (64, 200, 64), (64, 64, 255),
+               (255, 200, 0), (200, 0, 200), (0, 200, 200)]
+    for class_id, score, box in detections:
+        color = palette[class_id % len(palette)]
+        x1, y1, x2, y2 = [float(v) for v in box]
+        draw.rectangle([x1, y1, x2, y2], outline=color, width=2)
+        name = (label_map or {}).get(class_id, str(class_id))
+        draw.text((x1 + 2, max(y1 - 10, 0)), f"{name}:{score:.2f}",
+                  fill=color)
+    return np.asarray(img)
