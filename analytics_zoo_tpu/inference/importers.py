@@ -100,6 +100,10 @@ def import_tf_saved_model(path: str,
 
     loaded = tf.saved_model.load(path)
     variables = getattr(loaded, "variables", None) or []
+    if not variables:
+        raise ValueError(
+            f"SavedModel at {path!r} exposes no variables to import "
+            "(signature-only or non-Keras trackable export)")
     out: Dict = {}
     seen = set()
     for v in variables:
@@ -192,6 +196,12 @@ def _iter_fields(buf: bytes):
         yield field, wire, val
 
 
+def _signed(v: int) -> int:
+    """Two's-complement interpretation of a protobuf varint (negative
+    ints are encoded as 10-byte varints of their 64-bit pattern)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
 def _parse_tensor_proto(buf: bytes) -> Tuple[str, np.ndarray]:
     dims: List[int] = []
     dtype = np.float32
@@ -211,7 +221,11 @@ def _parse_tensor_proto(buf: bytes) -> Tuple[str, np.ndarray]:
                     d, p = _read_varint(val, p)
                     dims.append(d)
         elif field == 2:
-            dtype = _ONNX_DTYPES.get(val, np.float32)
+            if val not in _ONNX_DTYPES:
+                raise ValueError(
+                    f"unsupported ONNX tensor data_type {val} (bf16/fp8 "
+                    "initializers are not importable)")
+            dtype = _ONNX_DTYPES[val]
         elif field == 4:
             if wire == 5:
                 floats.append(struct.unpack("<f", val)[0])
@@ -219,20 +233,20 @@ def _parse_tensor_proto(buf: bytes) -> Tuple[str, np.ndarray]:
                 floats.extend(np.frombuffer(val, "<f4").tolist())
         elif field == 5:
             if wire == 0:
-                int32s.append(val)
+                int32s.append(_signed(val))
             else:
                 p = 0
                 while p < len(val):
                     d, p = _read_varint(val, p)
-                    int32s.append(d)
+                    int32s.append(_signed(d))
         elif field == 7:
             if wire == 0:
-                int64s.append(val)
+                int64s.append(_signed(val))
             else:
                 p = 0
                 while p < len(val):
                     d, p = _read_varint(val, p)
-                    int64s.append(d)
+                    int64s.append(_signed(d))
         elif field == 8:
             name = val.decode("utf-8")
         elif field == 9:

@@ -6,13 +6,20 @@ from analytics_zoo_tpu.keras.layers.core import (  # noqa: F401
     Dense,
     Dropout,
     Flatten,
+    GaussianDropout,
     GaussianNoise,
+    GaussianSampler,
     Highway,
     InputLayer,
     Lambda,
+    Masking,
+    MaxoutDense,
     Permute,
     RepeatVector,
     Reshape,
+    SpatialDropout1D,
+    SpatialDropout2D,
+    SpatialDropout3D,
     SReLU,
 )
 from analytics_zoo_tpu.keras.layers.convolutional import (  # noqa: F401
@@ -25,6 +32,9 @@ from analytics_zoo_tpu.keras.layers.convolutional import (  # noqa: F401
     Cropping2D,
     Cropping3D,
     Deconvolution2D,
+    LocallyConnected1D,
+    LocallyConnected2D,
+    ResizeBilinear,
     SeparableConvolution2D,
     UpSampling1D,
     UpSampling2D,
@@ -50,9 +60,12 @@ from analytics_zoo_tpu.keras.layers.pooling import (  # noqa: F401
 from analytics_zoo_tpu.keras.layers.normalization import (  # noqa: F401
     BatchNormalization,
     LayerNormalization,
+    LRN2D,
 )
 from analytics_zoo_tpu.keras.layers.embedding import (  # noqa: F401
     Embedding,
+    SparseDense,
+    SparseEmbedding,
     WordEmbedding,
 )
 from analytics_zoo_tpu.keras.layers.recurrent import (  # noqa: F401
@@ -60,6 +73,7 @@ from analytics_zoo_tpu.keras.layers.recurrent import (  # noqa: F401
     LSTM,
     Bidirectional,
     ConvLSTM2D,
+    ConvLSTM3D,
     SimpleRNN,
     TimeDistributed,
 )

@@ -275,3 +275,116 @@ UpSampling2D = _upsample_layer(2)
 UpSampling2D.__name__ = "UpSampling2D"
 UpSampling3D = _upsample_layer(3)
 UpSampling3D.__name__ = "UpSampling3D"
+
+
+class _LocallyConnectedModule(nn.Module):
+    """Unshared convolution: one kernel per output position. Patches are
+    extracted statically and contracted with a [positions, patch, out]
+    weight in ONE einsum -- MXU-friendly despite no weight sharing."""
+
+    units: int
+    kernel: Tuple[int, ...]
+    strides: Tuple[int, ...]
+    activation: Callable
+    use_bias: bool
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        spatial = x.shape[1:-1]
+        c_in = x.shape[-1]
+        k = self.kernel
+        s = self.strides
+        out_sizes = tuple((spatial[i] - k[i]) // s[i] + 1
+                          for i in range(len(k)))
+        n_pos = 1
+        for o in out_sizes:
+            n_pos *= o
+        patch = c_in
+        for kk in k:
+            patch *= kk
+        if len(k) == 1:
+            idx = (jnp.arange(out_sizes[0])[:, None] * s[0]
+                   + jnp.arange(k[0])[None, :])          # [O, K]
+            patches = x[:, idx]                          # [B, O, K, C]
+            patches = patches.reshape(x.shape[0], n_pos, patch)
+        else:
+            i0 = (jnp.arange(out_sizes[0])[:, None] * s[0]
+                  + jnp.arange(k[0])[None, :])           # [Oh, Kh]
+            j0 = (jnp.arange(out_sizes[1])[:, None] * s[1]
+                  + jnp.arange(k[1])[None, :])           # [Ow, Kw]
+            patches = x[:, i0][:, :, :, j0]              # [B,Oh,Kh,Ow,Kw,C]
+            patches = patches.transpose(0, 1, 3, 2, 4, 5)
+            patches = patches.reshape(x.shape[0], n_pos, patch)
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (n_pos, patch, self.units))
+        y = jnp.einsum("bpk,pku->bpu", patches, w)
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros,
+                           (n_pos, self.units))
+            y = y + b
+        y = y.reshape((x.shape[0],) + out_sizes + (self.units,))
+        return self.activation(y)
+
+
+class LocallyConnected1D(KerasLayer):
+    """Conv1D without weight sharing, 'valid' padding only
+    (ref: keras/layers/LocallyConnected1D.scala)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation=None, subsample_length: int = 1,
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activations.get(activation)
+        self.subsample_length = subsample_length
+        self.bias = bias
+
+    def _make_module(self):
+        return _LocallyConnectedModule(
+            units=self.nb_filter, kernel=(self.filter_length,),
+            strides=(self.subsample_length,), activation=self.activation,
+            use_bias=self.bias)
+
+
+class LocallyConnected2D(KerasLayer):
+    """Conv2D without weight sharing, 'valid' padding only
+    (ref: keras/layers/LocallyConnected2D.scala; channels-last)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample: Tuple[int, int] = (1, 1),
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activations.get(activation)
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def _make_module(self):
+        return _LocallyConnectedModule(
+            units=self.nb_filter, kernel=(self.nb_row, self.nb_col),
+            strides=self.subsample, activation=self.activation,
+            use_bias=self.bias)
+
+
+class ResizeBilinear(KerasLayer):
+    """Bilinear resize of [B, H, W, C] feature maps
+    (ref: keras/layers/ResizeBilinear.scala)."""
+
+    def __init__(self, output_height: int, output_width: int, **kwargs):
+        super().__init__(**kwargs)
+        self.output_height = output_height
+        self.output_width = output_width
+
+    def _make_module(self):
+        oh, ow = self.output_height, self.output_width
+
+        def fn(x):
+            import jax
+
+            return jax.image.resize(
+                x, (x.shape[0], oh, ow, x.shape[-1]), method="bilinear")
+
+        return FnModule(fn=fn)

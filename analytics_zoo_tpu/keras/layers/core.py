@@ -193,3 +193,152 @@ class _SReLUModule(nn.Module):
 class SReLU(KerasLayer):
     def _make_module(self):
         return _SReLUModule()
+
+
+class Masking(KerasLayer):
+    """Zero out timesteps whose features ALL equal ``mask_value``
+    (ref: keras/layers/Masking.scala): [B, T, ...] -> same shape with
+    masked steps zeroed, so downstream pooling/RNN state updates see
+    nothing from them."""
+
+    def __init__(self, mask_value: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.mask_value = mask_value
+
+    def _make_module(self):
+        mv = self.mask_value
+
+        def fn(x):
+            reduce_axes = tuple(range(2, x.ndim))
+            keep = jnp.any(x != mv, axis=reduce_axes) if reduce_axes \
+                else (x != mv)
+            shape = keep.shape + (1,) * (x.ndim - keep.ndim)
+            return x * keep.reshape(shape).astype(x.dtype)
+
+        return FnModule(fn=fn)
+
+
+class _MaxoutDenseModule(nn.Module):
+    units: int
+    nb_feature: int
+    use_bias: bool
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.Dense(self.units * self.nb_feature,
+                     use_bias=self.use_bias)(x)
+        y = y.reshape(y.shape[:-1] + (self.nb_feature, self.units))
+        return jnp.max(y, axis=-2)
+
+
+class MaxoutDense(KerasLayer):
+    """Max over ``nb_feature`` linear pieces
+    (ref: keras/layers/MaxoutDense.scala)."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+        self.bias = bias
+
+    def _make_module(self):
+        return _MaxoutDenseModule(units=self.output_dim,
+                                  nb_feature=self.nb_feature,
+                                  use_bias=self.bias)
+
+
+class _GaussianDropoutModule(nn.Module):
+    rate: float
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if not train or self.rate <= 0:
+            return x
+        rng = self.make_rng("dropout")
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        return x * (1.0 + stddev * jax.random.normal(rng, x.shape,
+                                                     x.dtype))
+
+
+class GaussianDropout(KerasLayer):
+    """Multiplicative 1-centered gaussian noise
+    (ref: keras/layers/GaussianDropout.scala)."""
+
+    def __init__(self, p: float, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p
+
+    def _make_module(self):
+        return _GaussianDropoutModule(rate=self.p)
+
+
+class _SpatialDropoutModule(nn.Module):
+    rate: float
+    spatial_ndim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if not train or self.rate <= 0:
+            return x
+        # drop whole channels: mask [B, 1, ..., 1, C]
+        rng = self.make_rng("dropout")
+        shape = (x.shape[0],) + (1,) * self.spatial_ndim + (x.shape[-1],)
+        keep = jax.random.bernoulli(rng, 1.0 - self.rate, shape)
+        return x * keep.astype(x.dtype) / (1.0 - self.rate)
+
+
+class _SpatialDropoutBase(KerasLayer):
+    spatial_ndim = 1
+
+    def __init__(self, p: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p
+
+    def _make_module(self):
+        return _SpatialDropoutModule(rate=self.p,
+                                     spatial_ndim=self.spatial_ndim)
+
+
+class SpatialDropout1D(_SpatialDropoutBase):
+    """Channel-wise dropout on [B, T, C]
+    (ref: keras/layers/SpatialDropout1D.scala; channels-last)."""
+
+    spatial_ndim = 1
+
+
+class SpatialDropout2D(_SpatialDropoutBase):
+    """Channel-wise dropout on [B, H, W, C]
+    (ref: keras/layers/SpatialDropout2D.scala)."""
+
+    spatial_ndim = 2
+
+
+class SpatialDropout3D(_SpatialDropoutBase):
+    """Channel-wise dropout on [B, D, H, W, C]
+    (ref: keras/layers/SpatialDropout3D.scala)."""
+
+    spatial_ndim = 3
+
+
+class _GaussianSamplerModule(nn.Module):
+    @nn.compact
+    def __call__(self, xs, train: bool = False):
+        if not isinstance(xs, (list, tuple)) or len(xs) != 2:
+            raise ValueError("GaussianSampler expects [mean, log_var]")
+        mean, log_var = xs
+        if not train:
+            return mean
+        rng = self.make_rng("dropout")
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(0.5 * log_var) * eps
+
+
+class GaussianSampler(KerasLayer):
+    """VAE reparameterization: sample N(mean, exp(log_var)) while
+    training, mean at inference (ref: keras/layers/GaussianSampler.scala
+    -- the reference samples unconditionally; returning the mean at
+    inference is the standard VAE deployment behavior)."""
+
+    def _make_module(self):
+        return _GaussianSamplerModule()

@@ -89,3 +89,72 @@ class WordEmbedding(Embedding):
             if w in vectors:
                 table[i] = vectors[w]
         return WordEmbedding(n, dim, weights=table, trainable=trainable)
+
+
+class _SparseEmbedModule(nn.Module):
+    vocab: int
+    dim: int
+    combiner: str
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: [B, K] int ids padded with 0; id 0 is the "no entry" slot.
+        # The reference feeds SparseTensor rows (SparseEmbedding.scala);
+        # the TPU-native encoding is padded dense ids + mask -- the
+        # gather rides the MXU-adjacent sparsecore/gather units and the
+        # pad rows contribute exactly zero.
+        ids = x.astype(jnp.int32)
+        table = nn.Embed(self.vocab + 1, self.dim, name="embedding")
+        emb = table(ids)                               # [B, K, D]
+        mask = (ids > 0).astype(emb.dtype)[..., None]  # [B, K, 1]
+        summed = jnp.sum(emb * mask, axis=-2)
+        if self.combiner == "sum":
+            return summed
+        count = jnp.maximum(jnp.sum(mask, axis=-2), 1.0)
+        if self.combiner == "mean":
+            return summed / count
+        if self.combiner == "sqrtn":
+            return summed / jnp.sqrt(count)
+        raise ValueError(self.combiner)
+
+
+class SparseEmbedding(KerasLayer):
+    """Embedding-sum over variable-length id bags encoded as 0-padded
+    [B, K] ids (ref: keras/layers/SparseEmbedding.scala over
+    SparseTensor input; combiner semantics of tf.nn.embedding_lookup_sparse)."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 combiner: str = "sum", **kwargs):
+        super().__init__(**kwargs)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.combiner = combiner
+
+    def _make_module(self):
+        return _SparseEmbedModule(vocab=self.input_dim,
+                                  dim=self.output_dim,
+                                  combiner=self.combiner)
+
+
+class SparseDense(KerasLayer):
+    """Dense layer over sparse-coded inputs (ref:
+    keras/layers/SparseDense.scala takes SparseTensor rows). TPU-first
+    collapse: XLA/MXU has no win for sparse activations at these sizes,
+    so inputs arrive 0-padded dense and this is ``Dense`` -- kept as a
+    distinct type for API parity."""
+
+    def __init__(self, output_dim: int, activation=None,
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        from analytics_zoo_tpu.keras import activations as acts
+
+        self.output_dim = output_dim
+        self.activation = acts.get(activation)
+        self.bias = bias
+
+    def _make_module(self):
+        from analytics_zoo_tpu.keras.layers.core import _DenseModule
+
+        return _DenseModule(units=self.output_dim,
+                            activation=self.activation,
+                            use_bias=self.bias)

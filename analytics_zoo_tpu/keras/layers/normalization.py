@@ -4,8 +4,9 @@ zoo/.../keras/layers/internal LayerNorm used by Transformer/BERT)."""
 from __future__ import annotations
 
 import flax.linen as nn
+import jax.numpy as jnp
 
-from analytics_zoo_tpu.keras.layers.base import KerasLayer
+from analytics_zoo_tpu.keras.layers.base import FnModule, KerasLayer
 
 
 class _BatchNormModule(nn.Module):
@@ -51,3 +52,29 @@ class LayerNormalization(KerasLayer):
 
     def _make_module(self):
         return _LayerNormModule(epsilon=self.epsilon)
+
+
+class LRN2D(KerasLayer):
+    """Local response normalization across channels on [B, H, W, C]
+    (ref: keras/layers/LRN2D.scala):
+    ``x / (k + alpha/n * sum_{local n channels} x^2)^beta``."""
+
+    def __init__(self, alpha: float = 1e-4, k: float = 1.0, beta: float =
+                 0.75, n: int = 5, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha, self.k, self.beta, self.n = alpha, k, beta, n
+
+    def _make_module(self):
+        alpha, k, beta, n = self.alpha, self.k, self.beta, self.n
+
+        def fn(x):
+            sq = x * x
+            half = n // 2
+            pad = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+            padded = jnp.pad(sq, pad)
+            acc = jnp.zeros_like(x)
+            for i in range(n):
+                acc = acc + padded[..., i:i + x.shape[-1]]
+            return x / jnp.power(k + (alpha / n) * acc, beta)
+
+        return FnModule(fn=fn)

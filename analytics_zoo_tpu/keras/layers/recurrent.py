@@ -150,3 +150,21 @@ class TimeDistributed(KerasLayer):
 
     def _make_module(self):
         return _TimeDistributedModule(inner=self.layer.build())
+
+
+class ConvLSTM3D(KerasLayer):
+    """x: [B, T, D, H, W, C] (ref: keras/layers/ConvLSTM3D.scala;
+    channels-last)."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int,
+                 return_sequences: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.nb_kernel = nb_kernel
+        self.return_sequences = return_sequences
+
+    def _make_module(self):
+        k = self.nb_kernel
+        return _RNNModule(cell_type="convlstm2d", units=self.nb_filter,
+                          return_sequences=self.return_sequences,
+                          conv_kernel=(k, k, k))

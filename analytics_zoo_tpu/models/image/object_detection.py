@@ -115,14 +115,20 @@ class ObjectDetector(ZooModel):
     def __init__(self, class_num: int, image_size: int = 128,
                  widths: Sequence[int] = (32, 64, 128),
                  anchors_per_cell: int = 4,
-                 label_map: Optional[Dict[int, str]] = None):
-        self._label_map = dict(label_map or {})
-        if anchors_per_cell < 3:
-            raise ValueError("anchors_per_cell must be >= 3 "
-                             "(2 square priors + aspect ratios)")
+                 label_map: Optional[Dict[Any, str]] = None):
+        # keys normalize to int; stored str-keyed in the json config so
+        # the map survives save_model/load_model
+        self._label_map = {int(k): v for k, v in (label_map or {}).items()}
+        ratio_bank = [2.0, 0.5, 3.0, 1.0 / 3.0]
+        if not 3 <= anchors_per_cell <= 2 + len(ratio_bank):
+            raise ValueError(
+                f"anchors_per_cell must be in [3, {2 + len(ratio_bank)}] "
+                "(2 square priors + up to 4 aspect ratios)")
         super().__init__(class_num=class_num, image_size=image_size,
                          widths=tuple(widths),
-                         anchors_per_cell=anchors_per_cell)
+                         anchors_per_cell=anchors_per_cell,
+                         label_map={str(k): v for k, v in
+                                    (label_map or {}).items()})
         # SAME-padded stride-2 convs produce ceil(s/2) grids; mirror
         # that exactly so anchor count always matches the head outputs
         s = -(-image_size // 2)   # stem block 1
@@ -135,13 +141,15 @@ class ObjectDetector(ZooModel):
         scales = [0.15 + 0.55 * i / max(n_scales - 1, 1)
                   for i in range(n_scales)]
         # 2 square priors per cell; remaining slots are aspect ratios
-        ratio_bank = [2.0, 0.5, 3.0, 1.0 / 3.0]
         ratios = [ratio_bank[:anchors_per_cell - 2]] * n_scales
         self.anchors = generate_anchors(image_size, feature_sizes,
                                         scales, ratios)
 
     def _build_module(self):
         c = self._config
+        # restore the label map on load_model (config keys are str)
+        self._label_map = {int(k): v
+                           for k, v in c.get("label_map", {}).items()}
         return SSDModule(class_num=c["class_num"],
                          image_size=c["image_size"],
                          widths=c["widths"],

@@ -165,6 +165,42 @@ class TestONNX:
         with pytest.raises(ValueError):
             import_onnx(b"\x12\x04abcd")
 
+    def test_negative_int64_data_varints(self):
+        # negative ints ride 10-byte two's-complement varints
+        def varint64(n):
+            n &= (1 << 64) - 1
+            out = b""
+            while True:
+                b7 = n & 0x7F
+                n >>= 7
+                out += bytes([b7 | (0x80 if n else 0)])
+                if not n:
+                    return out
+
+        def field(num, wire, payload):
+            tag = varint64((num << 3) | wire)
+            if wire == 2:
+                return tag + varint64(len(payload)) + payload
+            return tag + payload
+
+        t = field(1, 0, varint64(3))          # dims [3]
+        t += field(2, 0, varint64(7))         # int64
+        t += field(8, 2, b"axes")
+        for v in (-1, 0, 2):
+            t += field(7, 0, varint64(v))     # int64_data
+        graph = field(5, 2, t)
+        model = field(7, 2, graph)
+        params = import_onnx(model)
+        np.testing.assert_array_equal(params["axes"], [-1, 0, 2])
+
+    def test_rejects_unknown_dtype(self):
+        w = np.zeros((2, 2), np.float32)
+        data = _minimal_onnx_bytes({"x": w})
+        # patch the data_type varint (1 -> 16/bfloat16); field 2 wire 0
+        patched = data.replace(b"\x10\x01", b"\x10\x10", 1)
+        with pytest.raises(ValueError, match="data_type"):
+            import_onnx(patched)
+
     def test_rejects_truncated_onnx(self):
         w = np.arange(12, dtype=np.float32).reshape(3, 4)
         data = _minimal_onnx_bytes({"fc.weight": w})

@@ -53,8 +53,8 @@ class TestObjectDetector:
             for class_id, score, box in dets:
                 assert 1 <= class_id <= 3
                 assert box.shape == (4,)
-                assert (box[:2] <= box[2:]).all() or True  # clipped
-                assert 0 <= box[0] <= 64 and 0 <= box[3] <= 64
+                assert (box[:2] <= box[2:]).all()  # x1<=x2, y1<=y2
+                assert (box >= 0).all() and (box <= 64).all()  # clipped
 
     def test_non_power_of_two_image_size(self):
         # SAME convs ceil-divide; anchors must match the head outputs
@@ -70,6 +70,18 @@ class TestObjectDetector:
     def test_anchors_per_cell_guard(self):
         with pytest.raises(ValueError):
             ObjectDetector(class_num=2, anchors_per_cell=2)
+        with pytest.raises(ValueError):
+            ObjectDetector(class_num=2, anchors_per_cell=7)
+
+    def test_label_map_survives_save_load(self, tmp_path):
+        from analytics_zoo_tpu.models import ZooModel
+
+        det = ObjectDetector(class_num=2, image_size=64, widths=(8,),
+                             label_map={1: "cat", 2: "dog"})
+        det.estimator._ensure_built(det._example_input())
+        det.save_model(str(tmp_path / "m"))
+        det2 = ZooModel.load_model(str(tmp_path / "m"))
+        assert det2.label_of(1) == "cat" and det2.label_of(2) == "dog"
 
     def test_visualize_draws(self):
         img = np.zeros((64, 64, 3), np.float32)
