@@ -17,6 +17,10 @@ Top-level subpackages (reference analog in parens):
                   (TFDataset, FeatureSet, XShards)
 - ``keras``    -- Keras-style layer library + Sequential/Model
                   (zoo/pipeline/api/keras)
+- ``keras2``   -- Keras-2 argument-name API surface
+                  (zoo/pipeline/api/keras2)
+- ``autograd`` -- dual-mode symbolic/eager math ops + CustomLoss
+                  (zoo/pipeline/api/autograd)
 - ``learn``    -- Estimator: distributed fit/evaluate/predict
                   (InternalDistriOptimizer, zoo Estimator, Orca Estimator)
 - ``ops``      -- Pallas TPU kernels (flash attention, ...)

@@ -1,0 +1,205 @@
+"""autograd: symbolic math on KTensors + custom losses.
+
+The analog of the reference's autograd package
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/autograd/
+math.scala, Lambda.scala, CustomLoss.scala; python surface
+pyzoo/zoo/pipeline/api/autograd.py). Where the reference builds BigDL
+graphs from ``Variable`` nodes, here every op is dual-mode: applied to
+a symbolic ``KTensor`` it records a ``Lambda`` graph node; applied to a
+concrete array it runs eagerly as jnp -- the same function object works
+in model definitions and in custom losses (jax IS the autograd, so
+``CustomLoss`` is just a named wrapper the Estimator accepts).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+_uid = itertools.count()
+
+
+def _is_symbolic(*xs) -> bool:
+    from analytics_zoo_tpu.keras.engine import KTensor
+
+    return any(isinstance(x, KTensor) for x in xs)
+
+
+def _apply(name: str, fn: Callable, *xs):
+    """Dual-mode dispatch: Lambda node on KTensors, jnp eagerly else."""
+    if not _is_symbolic(*xs):
+        return fn(*xs)
+    from analytics_zoo_tpu.keras.engine import KTensor
+    from analytics_zoo_tpu.keras.layers.core import Lambda
+
+    tensors = [x for x in xs if isinstance(x, KTensor)]
+    consts = [None if isinstance(x, KTensor) else x for x in xs]
+
+    def wrapped(inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        it = iter(inputs)
+        args = [next(it) if c is None else c for c in consts]
+        return fn(*args)
+
+    lam = Lambda(wrapped, name=f"autograd_{name}_{next(_uid)}")
+    return lam(tensors if len(tensors) > 1 else tensors[0])
+
+
+# ---------------------------------------------------------- elementwise --
+def exp(x):
+    return _apply("exp", jnp.exp, x)
+
+
+def log(x):
+    return _apply("log", jnp.log, x)
+
+
+def sqrt(x):
+    return _apply("sqrt", jnp.sqrt, x)
+
+
+def square(x):
+    return _apply("square", jnp.square, x)
+
+
+def abs(x):  # noqa: A001 (reference API name)
+    return _apply("abs", jnp.abs, x)
+
+
+def neg(x):
+    return _apply("neg", jnp.negative, x)
+
+
+def pow(x, a: float):  # noqa: A001
+    return _apply("pow", lambda t: jnp.power(t, a), x)
+
+
+def clip(x, min_v: float, max_v: float):
+    return _apply("clip", lambda t: jnp.clip(t, min_v, max_v), x)
+
+
+def softsign(x):
+    return _apply("softsign", jax.nn.soft_sign, x)
+
+
+def softplus(x):
+    return _apply("softplus", jax.nn.softplus, x)
+
+
+def erf(x):
+    return _apply("erf", jax.scipy.special.erf, x)
+
+
+# ----------------------------------------------------------- reductions --
+def sum(x, axis: int = 0, keep_dims: bool = False):  # noqa: A001
+    """Reduction over a non-batch axis; ``axis`` is 0-based EXCLUDING
+    batch (reference convention, autograd.py sum)."""
+    return _apply("sum", lambda t: jnp.sum(t, axis=axis + 1,
+                                           keepdims=keep_dims), x)
+
+
+def mean(x, axis: int = 0, keep_dims: bool = False):
+    return _apply("mean", lambda t: jnp.mean(t, axis=axis + 1,
+                                             keepdims=keep_dims), x)
+
+
+def max(x, axis: int = 0, keep_dims: bool = False):  # noqa: A001
+    return _apply("max", lambda t: jnp.max(t, axis=axis + 1,
+                                           keepdims=keep_dims), x)
+
+
+def min(x, axis: int = 0, keep_dims: bool = False):  # noqa: A001
+    return _apply("min", lambda t: jnp.min(t, axis=axis + 1,
+                                           keepdims=keep_dims), x)
+
+
+def l2_normalize(x, axis: int = 0):
+    def fn(t):
+        n = jnp.sqrt(jnp.sum(t * t, axis=axis + 1, keepdims=True))
+        return t / jnp.maximum(n, 1e-12)
+
+    return _apply("l2_normalize", fn, x)
+
+
+# --------------------------------------------------------------- binary --
+def maximum(x, y):
+    return _apply("maximum", jnp.maximum, x, y)
+
+
+def minimum(x, y):
+    return _apply("minimum", jnp.minimum, x, y)
+
+
+def dot(x, y, axes=None):
+    """Batched contraction of the last axis of x with the first
+    non-batch axis of y (reference autograd ``dot``/``mm``)."""
+    def fn(a, b):
+        return jnp.einsum("b...i,bi...->b...", a, b) \
+            if a.ndim > 2 or b.ndim > 2 else jnp.einsum("bi,bi->b",
+                                                        a, b)[:, None]
+
+    return _apply("dot", fn, x, y)
+
+
+def batch_dot(x, y, axes=(2, 2)):
+    """Batched matmul contracting the given 1-based (incl. batch) axes
+    (reference autograd ``batch_dot``, matching keras.backend)."""
+    ax, ay = axes
+
+    def fn(a, b):
+        return jnp.matmul(jnp.moveaxis(a, ax, -1) if ax != a.ndim - 1
+                          else a,
+                          jnp.moveaxis(b, ay, -2) if ay != b.ndim - 2
+                          else b)
+
+    return _apply("batch_dot", fn, x, y)
+
+
+# ---------------------------------------------------------------- shape --
+def expand_dims(x, axis: int):
+    return _apply("expand_dims",
+                  lambda t: jnp.expand_dims(t, axis=axis), x)
+
+
+def squeeze(x, axis: int):
+    return _apply("squeeze", lambda t: jnp.squeeze(t, axis=axis), x)
+
+
+def stack(inputs, axis: int = 1):
+    return _apply("stack", lambda *ts: jnp.stack(ts, axis=axis), *inputs)
+
+
+def concat(inputs, axis: int = -1):
+    return _apply("concat",
+                  lambda *ts: jnp.concatenate(ts, axis=axis), *inputs)
+
+
+# ---------------------------------------------------------- custom loss --
+class CustomLoss:
+    """A named loss built from a plain function of (y_pred, y_true)
+    using the autograd ops above (ref: CustomLoss.scala /
+    autograd.py CustomLoss -- where the reference compiles a Variable
+    graph into a BigDL criterion, jax traces the function directly).
+
+    Accepted anywhere the Estimator takes a loss::
+
+        def my_loss(y_pred, y_true):
+            return A.mean(A.abs(y_pred - y_true), axis=0)
+        model.compile(optimizer="adam", loss=CustomLoss(my_loss))
+    """
+
+    def __init__(self, loss_fn: Callable, name: Optional[str] = None):
+        self.loss_fn = loss_fn
+        self.name = name or getattr(loss_fn, "__name__", "custom_loss")
+
+    def __call__(self, preds, labels):
+        out = self.loss_fn(preds, labels)
+        return jnp.mean(out)
+
+
+def mean_absolute_error(y_pred, y_true):
+    """Reference autograd example loss (autograd.py doc example)."""
+    return jnp.mean(jnp.abs(y_pred - y_true))

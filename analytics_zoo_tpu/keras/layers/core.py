@@ -197,9 +197,11 @@ class SReLU(KerasLayer):
 
 class Masking(KerasLayer):
     """Zero out timesteps whose features ALL equal ``mask_value``
-    (ref: keras/layers/Masking.scala): [B, T, ...] -> same shape with
-    masked steps zeroed, so downstream pooling/RNN state updates see
-    nothing from them."""
+    (ref: keras/layers/Masking.scala -- BigDL likewise zeroes masked
+    steps): [B, T, ...] -> same shape with masked steps zeroed.
+    Sum/max pooling then ignores them; RNNs still run their recurrence
+    over the zeroed steps (no mask channel propagates -- same as the
+    reference's BigDL layer set)."""
 
     def __init__(self, mask_value: float = 0.0, **kwargs):
         super().__init__(**kwargs)
@@ -267,6 +269,8 @@ class GaussianDropout(KerasLayer):
 
     def __init__(self, p: float, **kwargs):
         super().__init__(**kwargs)
+        if not 0.0 <= p < 1.0:
+            raise ValueError(f"p must be in [0, 1), got {p}")
         self.p = p
 
     def _make_module(self):
@@ -293,6 +297,8 @@ class _SpatialDropoutBase(KerasLayer):
 
     def __init__(self, p: float = 0.5, **kwargs):
         super().__init__(**kwargs)
+        if not 0.0 <= p < 1.0:
+            raise ValueError(f"p must be in [0, 1), got {p}")
         self.p = p
 
     def _make_module(self):

@@ -1,0 +1,19 @@
+"""keras2: Keras-2-style layer API surface.
+
+The analog of the reference's keras2 package
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/keras2/
+-- 21 layer files re-exposing keras layers under Keras-2 argument
+names; python surface pyzoo/zoo/pipeline/api/keras2/). Thin adapters:
+``units``/``filters``/``kernel_size``/``strides``/``padding``/``rate``
+map onto the keras-1-style layer library, and Sequential/Model/Input
+re-export unchanged.
+"""
+
+from analytics_zoo_tpu.keras import Input, Model, Sequential  # noqa: F401
+from analytics_zoo_tpu.keras2.layers import (  # noqa: F401
+    Activation, AveragePooling1D, AveragePooling2D, BatchNormalization,
+    Conv1D, Conv2D, Cropping1D, Dense, Dropout, Embedding, Flatten,
+    GlobalAveragePooling1D, GlobalAveragePooling2D,
+    GlobalAveragePooling3D, GlobalMaxPooling1D, GlobalMaxPooling2D,
+    GlobalMaxPooling3D, GRU, LocallyConnected1D, LSTM, MaxPooling1D,
+    MaxPooling2D, Softmax)

@@ -1,0 +1,135 @@
+"""Keras-2-arg-name adapters over the keras layer library
+(ref: zoo/.../pipeline/api/keras2/layers/*.scala -- Dense.scala maps
+``units``, Conv*.scala map ``filters``/``kernel_size``/``strides``/
+``padding``, Dropout.scala maps ``rate``, etc.)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+from analytics_zoo_tpu.keras import layers as k1
+
+# shape-preserving layers keep identical signatures: re-export
+Activation = k1.Activation
+Flatten = k1.Flatten
+GlobalAveragePooling1D = k1.GlobalAveragePooling1D
+GlobalAveragePooling2D = k1.GlobalAveragePooling2D
+GlobalAveragePooling3D = k1.GlobalAveragePooling3D
+GlobalMaxPooling1D = k1.GlobalMaxPooling1D
+GlobalMaxPooling2D = k1.GlobalMaxPooling2D
+GlobalMaxPooling3D = k1.GlobalMaxPooling3D
+Cropping1D = k1.Cropping1D
+BatchNormalization = k1.BatchNormalization
+Embedding = k1.Embedding
+
+
+def _pair(v) -> Tuple[int, int]:
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+class Dense(k1.Dense):
+    """keras2 Dense(units=...) (ref: keras2/layers/Dense.scala)."""
+
+    def __init__(self, units: int, activation=None, use_bias: bool = True,
+                 **kwargs):
+        super().__init__(output_dim=units, activation=activation,
+                         bias=use_bias, **kwargs)
+
+
+class Dropout(k1.Dropout):
+    """keras2 Dropout(rate=...) (ref: keras2/layers/Dropout.scala)."""
+
+    def __init__(self, rate: float, **kwargs):
+        super().__init__(p=rate, **kwargs)
+
+
+class Conv1D(k1.Convolution1D):
+    """(ref: keras2/layers/Conv1D.scala)."""
+
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 padding: str = "valid", activation=None,
+                 use_bias: bool = True, **kwargs):
+        super().__init__(nb_filter=filters, filter_length=kernel_size,
+                         subsample_length=strides, border_mode=padding,
+                         activation=activation, bias=use_bias, **kwargs)
+
+
+class Conv2D(k1.Convolution2D):
+    """(ref: keras2/layers/Conv2D.scala)."""
+
+    def __init__(self, filters: int,
+                 kernel_size: Union[int, Sequence[int]],
+                 strides: Union[int, Sequence[int]] = 1,
+                 padding: str = "valid", activation=None,
+                 use_bias: bool = True, **kwargs):
+        kh, kw = _pair(kernel_size)
+        super().__init__(nb_filter=filters, nb_row=kh, nb_col=kw,
+                         subsample=_pair(strides), border_mode=padding,
+                         activation=activation, bias=use_bias, **kwargs)
+
+
+class MaxPooling1D(k1.MaxPooling1D):
+    def __init__(self, pool_size: int = 2,
+                 strides: Optional[int] = None, padding: str = "valid",
+                 **kwargs):
+        super().__init__(pool_size=pool_size, strides=strides,
+                         border_mode=padding, **kwargs)
+
+
+class MaxPooling2D(k1.MaxPooling2D):
+    def __init__(self, pool_size=(2, 2), strides=None,
+                 padding: str = "valid", **kwargs):
+        super().__init__(pool_size=pool_size, strides=strides,
+                         border_mode=padding, **kwargs)
+
+
+class AveragePooling1D(k1.AveragePooling1D):
+    def __init__(self, pool_size: int = 2,
+                 strides: Optional[int] = None, padding: str = "valid",
+                 **kwargs):
+        super().__init__(pool_size=pool_size, strides=strides,
+                         border_mode=padding, **kwargs)
+
+
+class AveragePooling2D(k1.AveragePooling2D):
+    def __init__(self, pool_size=(2, 2), strides=None,
+                 padding: str = "valid", **kwargs):
+        super().__init__(pool_size=pool_size, strides=strides,
+                         border_mode=padding, **kwargs)
+
+
+class LSTM(k1.LSTM):
+    """keras2 LSTM(units=...)."""
+
+    def __init__(self, units: int, return_sequences: bool = False,
+                 go_backwards: bool = False, **kwargs):
+        super().__init__(output_dim=units,
+                         return_sequences=return_sequences,
+                         go_backwards=go_backwards, **kwargs)
+
+
+class GRU(k1.GRU):
+    """keras2 GRU(units=...)."""
+
+    def __init__(self, units: int, return_sequences: bool = False,
+                 go_backwards: bool = False, **kwargs):
+        super().__init__(output_dim=units,
+                         return_sequences=return_sequences,
+                         go_backwards=go_backwards, **kwargs)
+
+
+class LocallyConnected1D(k1.LocallyConnected1D):
+    """(ref: keras2/layers/LocallyConnected1D.scala)."""
+
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 activation=None, use_bias: bool = True, **kwargs):
+        super().__init__(nb_filter=filters, filter_length=kernel_size,
+                         subsample_length=strides, activation=activation,
+                         bias=use_bias, **kwargs)
+
+
+class Softmax(k1.Activation):
+    """(ref: keras2/layers/Softmax.scala)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(activation="softmax", **kwargs)

@@ -147,9 +147,6 @@ class ObjectDetector(ZooModel):
 
     def _build_module(self):
         c = self._config
-        # restore the label map on load_model (config keys are str)
-        self._label_map = {int(k): v
-                           for k, v in c.get("label_map", {}).items()}
         return SSDModule(class_num=c["class_num"],
                          image_size=c["image_size"],
                          widths=c["widths"],

@@ -1,0 +1,176 @@
+"""GANEstimator, autograd ops/CustomLoss, and the keras2 API surface."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.autograd as A
+from analytics_zoo_tpu.learn.gan import (
+    GANEstimator, discriminator_loss_vanilla,
+    generator_loss_nonsaturating)
+
+
+class _Gen(nn.Module):
+    out_dim: int = 2
+
+    @nn.compact
+    def __call__(self, z):
+        h = nn.relu(nn.Dense(16)(z))
+        return nn.Dense(self.out_dim)(h)
+
+
+class _Dis(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        h = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(1)(h)[:, 0]
+
+
+class TestGANEstimator:
+    def test_learns_gaussian_mean(self):
+        rng = np.random.RandomState(0)
+        target_mean = np.asarray([2.0, -1.0], np.float32)
+        data = (rng.randn(512, 2).astype(np.float32) * 0.3
+                + target_mean)
+        gan = GANEstimator(_Gen(), _Dis(), noise_dim=4,
+                           generator_optimizer="adam",
+                           discriminator_optimizer="adam")
+        history = gan.fit(data, batch_size=128, epochs=30)
+        assert np.isfinite(history[-1]["d_loss"])
+        assert np.isfinite(history[-1]["g_loss"])
+        samples = gan.generate(512)
+        err = np.abs(samples.mean(0) - target_mean).max()
+        assert err < 0.7, (samples.mean(0), target_mean)
+
+    def test_alternation_counts(self):
+        rng = np.random.RandomState(1)
+        data = rng.randn(64, 2).astype(np.float32)
+        gan = GANEstimator(_Gen(), _Dis(), noise_dim=4,
+                           generator_steps=2, discriminator_steps=3)
+        gan.fit(data, batch_size=32, epochs=1)
+        assert gan.g_vars is not None and gan.d_vars is not None
+
+    def test_loss_functions_finite(self):
+        logits = jnp.asarray([-2.0, 0.0, 3.0])
+        assert np.isfinite(float(generator_loss_nonsaturating(logits)))
+        assert np.isfinite(float(
+            discriminator_loss_vanilla(logits, -logits)))
+
+    def test_generate_before_fit_raises(self):
+        gan = GANEstimator(_Gen(), _Dis())
+        with pytest.raises(ValueError):
+            gan.generate(4)
+
+
+class TestAutogradEager:
+    def test_elementwise_ops(self):
+        x = jnp.asarray([[1.0, 4.0]])
+        np.testing.assert_allclose(np.asarray(A.sqrt(x)), [[1, 2]])
+        np.testing.assert_allclose(np.asarray(A.square(x)), [[1, 16]])
+        np.testing.assert_allclose(np.asarray(A.abs(-x)), [[1, 4]])
+        np.testing.assert_allclose(np.asarray(A.clip(x, 0, 2)),
+                                   [[1, 2]])
+        np.testing.assert_allclose(np.asarray(A.exp(A.log(x))), [[1, 4]],
+                                   rtol=1e-6)
+
+    def test_reductions_exclude_batch_axis(self):
+        x = jnp.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        np.testing.assert_allclose(np.asarray(A.mean(x, axis=0)),
+                                   [2.0, 5.0])
+        np.testing.assert_allclose(np.asarray(A.sum(x, axis=0)),
+                                   [6.0, 15.0])
+        np.testing.assert_allclose(np.asarray(A.max(x, axis=0)),
+                                   [3.0, 6.0])
+
+    def test_binary_and_shape_ops(self):
+        x = jnp.asarray([[1.0, -2.0]])
+        y = jnp.asarray([[0.5, 5.0]])
+        np.testing.assert_allclose(np.asarray(A.maximum(x, y)),
+                                   [[1.0, 5.0]])
+        assert A.expand_dims(x, 1).shape == (1, 1, 2)
+        assert A.stack([x, y], axis=1).shape == (1, 2, 2)
+        assert A.concat([x, y], axis=-1).shape == (1, 4)
+
+    def test_l2_normalize(self):
+        x = jnp.asarray([[3.0, 4.0]])
+        out = np.asarray(A.l2_normalize(x, axis=0))
+        np.testing.assert_allclose(out, [[0.6, 0.8]], rtol=1e-6)
+
+
+class TestAutogradSymbolic:
+    def test_ops_build_graph_and_run(self):
+        from analytics_zoo_tpu.keras import Input, Model
+
+        inp = Input(shape=(3,))
+        out = A.mean(A.square(inp), axis=0, keep_dims=True)
+        model = Model(inp, out)
+        x = np.asarray([[1.0, 2.0, 2.0]], np.float32)
+        pred = np.asarray(model.predict(x))
+        np.testing.assert_allclose(pred, [[3.0]], rtol=1e-5)
+
+    def test_custom_loss_trains(self):
+        from analytics_zoo_tpu.autograd import CustomLoss
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+
+        def mae_like(y_pred, y_true):
+            return A.abs(y_pred - y_true.reshape(y_pred.shape))
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 4).astype(np.float32)
+        y = x @ rng.randn(4, 1).astype(np.float32)
+        m = Sequential([Dense(8, activation="relu"), Dense(1)])
+        m.compile(optimizer="adam", loss=CustomLoss(mae_like))
+        hist = m.fit(x, y, batch_size=32, nb_epoch=5)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+class TestKeras2:
+    def test_dense_conv_api(self):
+        from analytics_zoo_tpu import keras2 as K2
+        from tests.test_keras import apply_layer
+
+        x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
+        out = apply_layer(K2.Conv2D(filters=4, kernel_size=3,
+                                    padding="same"), x)
+        assert out.shape == (2, 8, 8, 4)
+        out = apply_layer(K2.Conv2D(filters=4, kernel_size=(3, 5),
+                                    strides=2), x)
+        assert out.shape == (2, 3, 2, 4)
+        d = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+        assert apply_layer(K2.Dense(units=5, activation="relu"),
+                           d).shape == (4, 5)
+        assert apply_layer(K2.Softmax(), d).sum(-1) == pytest.approx(
+            np.ones(4), abs=1e-5)
+
+    def test_sequential_model_trains(self):
+        from analytics_zoo_tpu import keras2 as K2
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 6).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        m = K2.Sequential([
+            K2.Dense(units=16, activation="relu"),
+            K2.Dropout(rate=0.1),
+            K2.Dense(units=2),
+        ])
+        m.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy")
+        hist = m.fit(x, y, batch_size=32, nb_epoch=4)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_rnn_and_pooling(self):
+        from analytics_zoo_tpu import keras2 as K2
+        from tests.test_keras import apply_layer
+
+        x = np.random.RandomState(0).randn(2, 6, 4).astype(np.float32)
+        assert apply_layer(K2.LSTM(units=5), x).shape == (2, 5)
+        assert apply_layer(K2.GRU(units=5, return_sequences=True),
+                           x).shape == (2, 6, 5)
+        xi = np.random.RandomState(1).randn(2, 8, 3).astype(np.float32)
+        assert apply_layer(K2.MaxPooling1D(pool_size=2),
+                           xi).shape == (2, 4, 3)
+        assert apply_layer(K2.LocallyConnected1D(
+            filters=4, kernel_size=3), xi).shape == (2, 6, 4)
