@@ -135,17 +135,20 @@ def minimum(x, y):
 
 def dot(x, y, axes=None):
     """Batched contraction of the last axis of x with the first
-    non-batch axis of y (reference autograd ``dot``/``mm``)."""
+    non-batch axis of y (reference autograd ``dot``/``mm``):
+    [B, ..., K] x [B, K, ...] -> [B, ..., ...]; two 2-D inputs give the
+    per-row inner product [B, 1]."""
     def fn(a, b):
-        return jnp.einsum("b...i,bi...->b...", a, b) \
-            if a.ndim > 2 or b.ndim > 2 else jnp.einsum("bi,bi->b",
-                                                        a, b)[:, None]
+        if a.ndim == 2 and b.ndim == 2:
+            return jnp.einsum("bi,bi->b", a, b)[:, None]
+        return jax.vmap(
+            lambda u, v: jnp.tensordot(u, v, axes=(-1, 0)))(a, b)
 
     return _apply("dot", fn, x, y)
 
 
 def batch_dot(x, y, axes=(2, 2)):
-    """Batched matmul contracting the given 1-based (incl. batch) axes
+    """Batched matmul contracting the given 0-based (incl. batch) axes
     (reference autograd ``batch_dot``, matching keras.backend)."""
     ax, ay = axes
 

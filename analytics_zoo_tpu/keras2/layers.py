@@ -8,6 +8,7 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple, Union
 
 from analytics_zoo_tpu.keras import layers as k1
+from analytics_zoo_tpu.keras.layers.convolutional import _tup
 
 # shape-preserving layers keep identical signatures: re-export
 Activation = k1.Activation
@@ -24,7 +25,7 @@ Embedding = k1.Embedding
 
 
 def _pair(v) -> Tuple[int, int]:
-    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+    return _tup(v, 2)
 
 
 class Dense(k1.Dense):

@@ -77,7 +77,7 @@ class GANEstimator:
         self._step = None
 
     # ------------------------------------------------------------ build --
-    def _ensure_built(self, example_batch: np.ndarray) -> None:
+    def _ensure_built(self) -> None:
         if self.g_vars is not None:
             return
         self._rng, gk, dk = jax.random.split(self._rng, 3)
@@ -154,8 +154,11 @@ class GANEstimator:
     def fit(self, data, batch_size: int, epochs: int = 1
             ) -> List[Dict[str, float]]:
         dataset = _as_dataset(data, labeled=False)
-        example = next(dataset.batches(batch_size, shuffle=False))[0]
-        self._ensure_built(example)
+        if dataset.num_samples < batch_size:
+            raise ValueError(
+                f"dataset ({dataset.num_samples} samples) is smaller "
+                f"than batch_size {batch_size}")
+        self._ensure_built()
         step = self._build_step()
         history: List[Dict[str, float]] = []
         for epoch in range(epochs):

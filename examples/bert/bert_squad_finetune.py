@@ -1,0 +1,54 @@
+"""BERT SQuAD-style span fine-tune (north-star workload #4;
+ref: pyzoo/zoo/tfpark/text/estimator/bert_squad.py): BERT encoder +
+start/end span heads trained with the flash-attention path.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu.models.text.bert_squad import BERTSQuAD
+
+
+def synthetic_squad(n, seq, vocab, seed=0):
+    """Questions whose 'answer span' is marked by a sentinel token."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(5, vocab, (n, seq)).astype(np.int32)
+    starts = rng.randint(1, seq - 4, n)
+    ends = starts + rng.randint(1, 4, n)
+    sentinel_open, sentinel_close = 2, 3
+    for i in range(n):
+        ids[i, starts[i] - 1] = sentinel_open
+        ids[i, ends[i] + 1] = sentinel_close
+    y = np.stack([starts, ends], 1).astype(np.int32)
+    return {"input_ids": ids}, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    seq = 128
+    vocab = 1000
+    n = 256 if args.quick else 4096
+    epochs = 3 if args.quick else 6
+
+    x, y = synthetic_squad(n, seq, vocab)
+    model = BERTSQuAD(vocab=vocab, hidden_size=64, n_block=2, n_head=4,
+                      intermediate_size=128, max_position_len=seq)
+    model.fit((x, y), batch_size=32, epochs=epochs)
+    start_logits, end_logits = model.predict(
+        {"input_ids": x["input_ids"][:64]}, batch_size=32)
+    spans = model.decode_spans(start_logits, end_logits)
+    acc = (spans[:, 0] == y[:64, 0]).mean()
+    print(f"start-position accuracy on train head: {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

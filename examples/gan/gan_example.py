@@ -1,0 +1,53 @@
+"""GAN training with GANEstimator (ref: pyzoo/zoo/examples/tfpark/gan):
+learn a 2-D gaussian mixture mode with alternating G/D updates.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import flax.linen as nn
+import numpy as np
+
+from analytics_zoo_tpu.learn import GANEstimator
+
+
+class Generator(nn.Module):
+    @nn.compact
+    def __call__(self, z):
+        h = nn.relu(nn.Dense(32)(z))
+        return nn.Dense(2)(h)
+
+
+class Discriminator(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        h = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(1)(h)[:, 0]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    n = 1024 if args.quick else 8192
+    epochs = 20 if args.quick else 100
+
+    rng = np.random.RandomState(0)
+    data = (rng.randn(n, 2).astype(np.float32) * 0.4
+            + np.asarray([1.5, -0.5], np.float32))
+    gan = GANEstimator(Generator(), Discriminator(), noise_dim=8)
+    history = gan.fit(data, batch_size=128, epochs=epochs)
+    print("final:", {k: round(v, 3)
+                     for k, v in history[-1].items() if k != "seconds"})
+    samples = gan.generate(512)
+    print("generated mean:", samples.mean(0).round(2),
+          "(target [1.5, -0.5])")
+
+
+if __name__ == "__main__":
+    main()

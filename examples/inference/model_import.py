@@ -1,0 +1,51 @@
+"""Foreign-model import + InferenceModel predict
+(ref: TFNet/TorchModel interop, zoo/.../pipeline/api/net/): bring a
+torch model's weights into the JAX runtime and serve predictions.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import flax.linen as nn
+import numpy as np
+
+from analytics_zoo_tpu.inference import (
+    InferenceModel, import_torch_state_dict)
+
+
+class FlaxNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Dense(16, name="fc1")(x))
+        return nn.Dense(3, name="fc2")(x)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    import torch
+
+    tnet = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 3))
+    params = import_torch_state_dict(
+        tnet.state_dict(),
+        key_map={"0": "fc1", "2": "fc2"})
+
+    model = InferenceModel()
+    model.load_flax(FlaxNet(), {"params": params})
+    x = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    ours = np.asarray(model.predict(x))
+    theirs = tnet(torch.from_numpy(x)).detach().numpy()
+    err = np.abs(ours - theirs).max()
+    print(f"torch-import predict parity: max err {err:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""DataFrame-native training with NNFrames
+(ref: pyzoo/zoo/examples/nnframes + the dogs-vs-cats transfer-learning
+app): NNClassifier.fit(df) -> NNClassifierModel.transform(df).
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras.layers import Dense
+from analytics_zoo_tpu.nnframes import NNClassifier, SeqToTensor
+
+
+def make_df(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] - 0.25 * x[:, 2] > 0).astype(np.int64)
+    return pd.DataFrame({"features": [row for row in x], "label": y})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    n = 512 if args.quick else 8192
+    epochs = 5 if args.quick else 20
+
+    df = make_df(n)
+    train, test = df.iloc[:int(0.9 * n)], df.iloc[int(0.9 * n):]
+    clf = (NNClassifier(
+        Sequential([Dense(32, activation="relu"), Dense(2)]),
+        feature_preprocessing=SeqToTensor([8]))
+        .setBatchSize(64).setMaxEpoch(epochs).setLearningRate(1e-2))
+    model = clf.fit(train)
+    out = model.transform(test)
+    acc = (out["prediction"].values == test["label"].values).mean()
+    print(f"test accuracy: {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

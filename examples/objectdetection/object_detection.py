@@ -1,0 +1,47 @@
+"""SSD object-detection predict pipeline
+(ref: pyzoo/zoo/examples/objectdetection/predict.py): detect() on a
+batch of images and draw the boxes.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu.models import ObjectDetector
+from analytics_zoo_tpu.models.image.object_detection import visualize
+
+LABELS = {1: "cat", 2: "dog", 3: "bird"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="optional path to save a visualization png")
+    args = ap.parse_args()
+
+    det = ObjectDetector(class_num=3, image_size=128,
+                         label_map=LABELS)
+    rng = np.random.RandomState(0)
+    images = rng.uniform(0, 255, (4, 128, 128, 3)).astype(np.float32)
+    results = det.detect(images / 255.0, score_threshold=0.3, top_k=5)
+    for i, dets in enumerate(results):
+        pretty = [(det.label_of(c), round(s, 3)) for c, s, _ in dets]
+        print(f"image {i}: {pretty}")
+
+    if args.out:
+        from PIL import Image
+
+        drawn = visualize(images[0], results[0], LABELS)
+        Image.fromarray(drawn).save(args.out)
+        print("saved", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""QA ranking with KNRM over Relations
+(ref: pyzoo/zoo/examples/qaranker/qa_ranker.py): question/answer
+corpora -> relation pairs -> pairwise rank_hinge training -> NDCG-style
+check that positives outrank negatives.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu.feature import Relation, TextSet
+from analytics_zoo_tpu.feature.text import (
+    from_relation_lists, from_relation_pairs)
+from analytics_zoo_tpu.models import KNRM
+
+Q_LEN, A_LEN = 6, 10
+
+
+def build_corpora(n_q, seed=0):
+    rng = np.random.RandomState(seed)
+    topics = ["jax", "tpu", "mesh", "shard", "kernel", "compile"]
+    questions, answers, relations = [], [], []
+    for i in range(n_q):
+        topic = topics[rng.randint(len(topics))]
+        questions.append((f"q{i}", f"what is {topic} and how to use it"))
+        answers.append((f"a{i}_pos",
+                        f"{topic} is used like this {topic} example"))
+        off_topic = topics[rng.randint(len(topics))]
+        answers.append((f"a{i}_neg",
+                        f"unrelated text about {off_topic} cooking"))
+        relations.append(Relation(f"q{i}", f"a{i}_pos", 1))
+        relations.append(Relation(f"q{i}", f"a{i}_neg", 0))
+    q_set = TextSet.from_texts([t for _, t in questions])
+    for f, (uri, _) in zip(q_set.features, questions):
+        f.uri = uri
+    a_set = TextSet.from_texts([t for _, t in answers])
+    for f, (uri, _) in zip(a_set.features, answers):
+        f.uri = uri
+    q_set.tokenize().word2idx().shape_sequence(len=Q_LEN)\
+         .generate_sample()
+    a_set.set_word_index(q_set.get_word_index())
+    a_set.tokenize().word2idx(existing_map=q_set.get_word_index())\
+         .shape_sequence(len=A_LEN).generate_sample()
+    return q_set, a_set, relations
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    n_q = 32 if args.quick else 256
+    epochs = 5 if args.quick else 20
+
+    q_set, a_set, relations = build_corpora(n_q)
+    pairs = from_relation_pairs(relations, q_set, a_set)
+    vocab = max(max(q_set.get_word_index().values()),
+                max(a_set.get_word_index().values()))
+    model = KNRM(text1_length=Q_LEN, text2_length=A_LEN, vocab=vocab,
+                 embed_dim=16)
+    model.fit(pairs, batch_size=16, epochs=epochs)
+
+    # ranking evaluation: positive should outscore negative per query
+    lists = from_relation_lists(relations, q_set, a_set)
+    wins = 0
+    for x, y in lists:
+        scores = np.asarray(model.predict(x, batch_size=8)).ravel()
+        wins += int(scores[np.argmax(y)] > scores[np.argmin(y)])
+    print(f"pairwise ranking accuracy: {wins / len(lists):.3f}")
+
+
+if __name__ == "__main__":
+    main()

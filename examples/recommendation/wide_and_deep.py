@@ -1,0 +1,55 @@
+"""Wide & Deep recommendation (ref workload #2:
+apps/recommendation-wide-n-deep/wide_n_deep.ipynb): joint wide
+(memorization) + deep (generalization) model over categorical and
+continuous features.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu.models import ColumnFeatureInfo, WideAndDeep
+
+
+def synthetic_tabular(n, seed=0):
+    rng = np.random.RandomState(seed)
+    wide = rng.randint(1, 20, (n, 2)).astype(np.int32)
+    embed = rng.randint(0, 10, (n, 2)).astype(np.int32)
+    cont = rng.randn(n, 3).astype(np.float32)
+    y = ((wide[:, 0] > 10).astype(int) + (cont[:, 0] > 0) + 1
+         ).astype(np.int32)  # ratings 1..3
+    return {"wide": wide, "embed": embed, "continuous": cont}, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--model-type", default="wide_n_deep",
+                    choices=["wide_n_deep", "wide", "deep"])
+    args = ap.parse_args()
+    n = 10_000 if args.quick else 100_000
+    epochs = 3 if args.quick else 10
+
+    info = ColumnFeatureInfo(
+        wide_base_cols=["a", "b"], wide_base_dims=[10, 10],
+        embed_cols=["c", "d"], embed_in_dims=[10, 10],
+        embed_out_dims=[8, 8], continuous_cols=["x", "y", "z"])
+    x, y = synthetic_tabular(n)
+    cut = int(0.9 * n)
+    model = WideAndDeep(args.model_type, class_num=3,
+                        column_info=info)
+    model.fit(({k: v[:cut] for k, v in x.items()}, y[:cut]),
+              batch_size=512, epochs=epochs)
+    res = model.evaluate(({k: v[cut:] for k, v in x.items()}, y[cut:]),
+                         batch_size=512)
+    print("validation:", res)
+
+
+if __name__ == "__main__":
+    main()

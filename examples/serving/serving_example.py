@@ -1,0 +1,81 @@
+"""Streaming model serving end-to-end
+(ref: Cluster Serving -- ClusterServing.scala + client.py +
+FrontEndApp.scala): queue clients + micro-batching worker + HTTP
+/predict + /metrics.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+import json
+import urllib.request
+
+import flax.linen as nn
+import numpy as np
+
+from analytics_zoo_tpu.inference import InferenceModel
+from analytics_zoo_tpu.serving import (
+    HttpFrontend, InputQueue, OutputQueue, ServingWorker)
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dense(3)(nn.relu(nn.Dense(16)(x)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--requests", type=int, default=32)
+    args = ap.parse_args()
+
+    import jax
+
+    net = Net()
+    variables = net.init(jax.random.PRNGKey(0),
+                         np.zeros((1, 4), np.float32))
+    model = InferenceModel()
+    model.load_flax(net, variables)
+
+    in_q, out_q = InputQueue(maxlen=1024), OutputQueue()
+    worker = ServingWorker(model, in_q, out_q, batch_size=8,
+                           timeout_ms=5).start()
+
+    # --- queue-client path (InputQueue/OutputQueue, client.py parity)
+    rng = np.random.RandomState(0)
+    for i in range(args.requests):
+        in_q.enqueue(f"req-{i}",
+                     input=rng.randn(4).astype(np.float32))
+    got = {}
+    while len(got) < args.requests:
+        uri, tensors = out_q.dequeue(timeout=10)
+        got[uri] = tensors
+    print(f"queue path: {len(got)} responses, "
+          f"output shape {got['req-0']['output'].shape}")
+
+    # --- HTTP path (/predict + /metrics, FrontEndApp parity)
+    frontend = HttpFrontend(in_q, out_q, worker=worker).start()
+    payload = json.dumps(
+        {"inputs": {"input": rng.randn(4).astype(
+            np.float32).tolist()}}).encode()
+    req = urllib.request.Request(
+        frontend.address + "/predict", data=payload,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=20) as resp:
+        print("http /predict:", json.loads(resp.read()).keys())
+    with urllib.request.urlopen(frontend.address + "/metrics",
+                                timeout=20) as resp:
+        metrics = json.loads(resp.read())
+        print("http /metrics stages:",
+              sorted(metrics)[:4], "...")
+    frontend.stop()
+    worker.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Text classification from raw strings through the TextSet pipeline
+(ref: pyzoo/zoo/examples/textclassification/text_classification.py):
+tokenize -> normalize -> word2idx -> shape_sequence -> train.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu.feature import TextSet
+from analytics_zoo_tpu.models import TextClassifier
+
+POS = ["great excellent wonderful film loved every scene",
+       "superb acting and a moving story truly memorable",
+       "brilliant direction delightful script a joy to watch"]
+NEG = ["terrible boring plot awful acting a waste of time",
+       "dreadful pacing hated the characters and the ending",
+       "poor script dull scenes utterly forgettable film"]
+
+
+def corpus(n_per_class, seed=0):
+    rng = np.random.RandomState(seed)
+    texts, labels = [], []
+    for label, bank in [(1, POS), (0, NEG)]:
+        for _ in range(n_per_class):
+            words = " ".join(bank[rng.randint(len(bank))].split())
+            texts.append(words)
+            labels.append(label)
+    return texts, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--encoder", default="cnn",
+                    choices=["cnn", "lstm", "gru"])
+    args = ap.parse_args()
+    n = 100 if args.quick else 1000
+    epochs = 5 if args.quick else 20
+
+    texts, labels = corpus(n)
+    ts = (TextSet.from_texts(texts, labels)
+          .tokenize().normalize().word2idx()
+          .shape_sequence(len=12).generate_sample())
+    x, y = ts.to_arrays()
+    train, val = ts.random_split(0.8)
+
+    model = TextClassifier(class_num=2,
+                           vocab=len(ts.get_word_index()),
+                           embed_dim=32, sequence_length=12,
+                           encoder=args.encoder)
+    xt, yt = train.to_arrays()
+    xv, yv = val.to_arrays()
+    model.fit((xt, yt), batch_size=32, epochs=epochs)
+    print("validation:", model.evaluate((xv, yv), batch_size=32))
+
+
+if __name__ == "__main__":
+    main()

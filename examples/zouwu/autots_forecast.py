@@ -1,0 +1,52 @@
+"""AutoTS time-series forecasting
+(ref: zouwu use-case notebooks + pyzoo/zoo/zouwu/autots/forecast.py):
+AutoTSTrainer searches feature/model configs and returns a TSPipeline
+for predict/evaluate.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.automl.recipes import SmokeRecipe
+from analytics_zoo_tpu.zouwu import AutoTSTrainer
+
+
+def synthetic_df(n, seed=0):
+    rng = np.random.RandomState(seed)
+    t = np.arange(n)
+    value = (10 + np.sin(t / 24.0 * 2 * np.pi) * 3
+             + 0.3 * rng.randn(n))
+    return pd.DataFrame({
+        "datetime": pd.date_range("2024-01-01", periods=n, freq="h"),
+        "value": value.astype(np.float32),
+    })
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    n = 600 if args.quick else 4000
+
+    df = synthetic_df(n)
+    cut = int(0.9 * n)
+    trainer = AutoTSTrainer(horizon=1, dt_col="datetime",
+                            target_col="value")
+    pipeline = trainer.fit(df.iloc[:cut], df.iloc[cut:],
+                           recipe=SmokeRecipe(), metric="mse")
+    res = pipeline.evaluate(df.iloc[cut:], metrics=["mse", "smape"])
+    print("holdout:", res)
+    preds = pipeline.predict(df.iloc[cut:])
+    print("forecast head:", preds["value"].head().round(3).tolist())
+
+
+if __name__ == "__main__":
+    main()

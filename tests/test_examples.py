@@ -1,0 +1,48 @@
+"""Smoke-run every example with --quick in a fresh process -- the
+analog of the reference's run-example-tests*.sh scripts
+(ref: pyzoo/zoo/examples/run-example-tests.sh)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = [
+    "recommendation/ncf_explicit_feedback.py",
+    "recommendation/wide_and_deep.py",
+    "textclassification/text_classification.py",
+    "qaranker/qa_ranker.py",
+    "anomalydetection/anomaly_detection.py",
+    "zouwu/autots_forecast.py",
+    "bert/bert_squad_finetune.py",
+    "nnframes/nnframes_classifier.py",
+    "inference/model_import.py",
+    "serving/serving_example.py",
+    "gan/gan_example.py",
+    "objectdetection/object_detection.py",
+    "parallel/long_context_ring_attention.py",
+]
+
+# runs the example on the CPU backend inside the test environment
+# (examples themselves are backend-agnostic)
+WRAPPER = (
+    "import jax; jax.config.update('jax_platforms', 'cpu');"
+    "import runpy, sys; sys.path.insert(0, {repo!r});"
+    "sys.argv = ['example', '--quick'];"
+    "runpy.run_path({path!r}, run_name='__main__')"
+)
+
+
+@pytest.mark.parametrize("rel", EXAMPLES)
+def test_example_quick(rel):
+    path = os.path.join(REPO, "examples", rel)
+    code = WRAPPER.format(repo=REPO, path=path)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=600, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"{rel} failed:\nstdout: {proc.stdout[-2000:]}\n"
+        f"stderr: {proc.stderr[-3000:]}")
+    assert proc.stdout.strip(), f"{rel} printed nothing"

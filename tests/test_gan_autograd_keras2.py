@@ -93,6 +93,18 @@ class TestAutogradEager:
         assert A.stack([x, y], axis=1).shape == (1, 2, 2)
         assert A.concat([x, y], axis=-1).shape == (1, 4)
 
+    def test_dot_3d_contraction(self):
+        a = jnp.ones((2, 3, 4))
+        b = jnp.ones((2, 4, 5))
+        out = A.dot(a, b)
+        assert out.shape == (2, 3, 5)
+        np.testing.assert_allclose(np.asarray(out), 4.0)
+
+    def test_gan_small_dataset_raises(self):
+        gan = GANEstimator(_Gen(), _Dis())
+        with pytest.raises(ValueError, match="smaller"):
+            gan.fit(np.zeros((10, 2), np.float32), batch_size=128)
+
     def test_l2_normalize(self):
         x = jnp.asarray([[3.0, 4.0]])
         out = np.asarray(A.l2_normalize(x, axis=0))
