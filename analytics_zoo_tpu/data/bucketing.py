@@ -1,0 +1,120 @@
+"""Length-bucketing for variable-length sequences under XLA.
+
+The reference leans on TF1 feed-dict shape flexibility for text data
+(ref: pyzoo/zoo/tfpark/tf_dataset.py:115-175 ``hard_code_batch_size``
+foreshadows the problem; SURVEY.md section 7 flags "dynamic-shape data
+under XLA" as a hard part). XLA compiles per shape, so the TPU-native
+strategy is: assign each sequence to a small set of length buckets, pad
+within the bucket, and let jit cache ONE executable per bucket shape --
+bounded compiles, minimal padding waste.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def bucket_boundaries_for(lengths: Sequence[int], n_buckets: int = 4,
+                          multiple: int = 8) -> List[int]:
+    """Quantile-based boundaries rounded up to ``multiple`` (XLA-tidy
+    shapes), deduplicated, covering the max length."""
+    lengths = np.asarray(lengths)
+    qs = np.quantile(lengths, np.linspace(0, 1, n_buckets + 1)[1:])
+    bounds = sorted({int(-(-q // multiple) * multiple) for q in qs})
+    top = int(-(-lengths.max() // multiple) * multiple)
+    if not bounds or bounds[-1] < top:
+        bounds.append(top)
+    return bounds
+
+
+class SequenceBuckets:
+    """Variable-length int sequences -> per-bucket padded arrays.
+
+    Args:
+      sequences: list of 1-D int arrays/lists (token ids).
+      labels: optional per-sequence labels.
+      boundaries: ascending max-length per bucket; sequences longer than
+        the last boundary are TRUNCATED to it (keep-tail, matching
+        SequenceShaper's default 'pre' mode). None derives quantile
+        boundaries.
+      pad_value: fill for the padded tail.
+    """
+
+    def __init__(self, sequences: Sequence[Any], labels: Optional[
+            Sequence[Any]] = None,
+            boundaries: Optional[Sequence[int]] = None,
+            n_buckets: int = 4, pad_value: int = 0):
+        seqs = [np.asarray(s, np.int32) for s in sequences]
+        lens = [len(s) for s in seqs]
+        if boundaries is None:
+            boundaries = bucket_boundaries_for(lens, n_buckets)
+        self.boundaries = list(boundaries)
+        self.pad_value = pad_value
+        per_bucket: List[List[int]] = [[] for _ in self.boundaries]
+        for i, ln in enumerate(lens):
+            for b, bound in enumerate(self.boundaries):
+                if ln <= bound:
+                    per_bucket[b].append(i)
+                    break
+            else:
+                per_bucket[-1].append(i)  # over-long: truncate into top
+        self._buckets: List[Tuple[int, np.ndarray,
+                                  Optional[np.ndarray]]] = []
+        labels_arr = (np.asarray(labels) if labels is not None else None)
+        self._real_tokens = 0
+        for bound, idxs in zip(self.boundaries, per_bucket):
+            if not idxs:
+                continue
+            x = np.full((len(idxs), bound), pad_value, np.int32)
+            for row, i in enumerate(idxs):
+                s = seqs[i][-bound:]  # truncate keeps the tail
+                x[row, :len(s)] = s
+                self._real_tokens += len(s)
+            y = labels_arr[idxs] if labels_arr is not None else None
+            self._buckets.append((bound, x, y))
+
+    def __len__(self) -> int:
+        return len(self._buckets)
+
+    def __iter__(self) -> Iterator[Tuple[int, np.ndarray, Any]]:
+        return iter(self._buckets)
+
+    @property
+    def padding_waste(self) -> float:
+        """Fraction of padded positions across all buckets -- the
+        figure of merit bucketing minimizes. Computed from the true
+        sequence lengths, so genuine tokens equal to ``pad_value``
+        don't count as padding."""
+        total = sum(x.size for _, x, _ in self._buckets)
+        return 1.0 - self._real_tokens / max(total, 1)
+
+    def datasets(self):
+        """One ZooDataset per non-empty bucket."""
+        from analytics_zoo_tpu.data.dataset import ZooDataset
+
+        out = []
+        for _, x, y in self._buckets:
+            out.append(ZooDataset.from_ndarrays(x, y))
+        return out
+
+
+def fit_bucketed(estimator, buckets: SequenceBuckets, batch_size: int,
+                 epochs: int = 1, **fit_kwargs) -> List[Any]:
+    """Train one Estimator across every bucket: each epoch walks the
+    buckets (largest first, so the biggest compile happens up front);
+    jit caches one train step per bucket shape. Returns the concatenated
+    per-bucket histories."""
+    histories = []
+    data = sorted(buckets, key=lambda t: -t[0])
+    for _ in range(epochs):
+        for _, x, y in data:
+            if len(x) < batch_size:
+                continue  # short-remainder bucket: skip, not recompile
+            # Estimator.fit's ``epochs`` is an absolute target over the
+            # estimator's lifetime; one more epoch per bucket pass
+            histories.extend(estimator.fit(
+                (x, y) if y is not None else x, batch_size=batch_size,
+                epochs=estimator.epoch + 1, **fit_kwargs))
+    return histories

@@ -20,6 +20,7 @@ from analytics_zoo_tpu.inference.encrypt import (  # noqa: F401
     encrypt_bytes,
 )
 from analytics_zoo_tpu.inference.importers import (  # noqa: F401
+    import_caffe,
     import_onnx,
     import_tf_frozen_graph,
     import_tf_saved_model,

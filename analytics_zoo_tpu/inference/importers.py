@@ -310,3 +310,107 @@ def import_onnx(path_or_bytes, key_map: Optional[Dict[str, str]] = None,
         leaf, arr = _remap_torch_weight(parts[-1], arr, transpose_linear)
         _nest(out, parts[:-1], leaf, arr)
     return out
+
+
+# -------------------------------------------------------------- Caffe --
+# caffe.proto field numbers (public schema): NetParameter.layer=100
+# (LayerParameter) / .layers=2 (legacy V1LayerParameter);
+# LayerParameter: name=1, blobs=7; V1LayerParameter: name=4, blobs=6;
+# BlobProto: data=5 (packed float), shape=7 (BlobShape.dim=1),
+# legacy dims num/channels/height/width=1..4.
+
+
+def _parse_caffe_blob(buf: bytes) -> np.ndarray:
+    dims: List[int] = []
+    legacy = [None, None, None, None]
+    chunks: List[bytes] = []
+    for field, wire, val in _iter_fields(buf):
+        if field == 5:  # data (packed in practice; one frombuffer)
+            chunks.append(val if wire == 2 else bytes(val))
+        elif field == 7:  # shape: BlobShape
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1:
+                    if w2 == 0:
+                        dims.append(_signed(v2))
+                    else:
+                        p = 0
+                        while p < len(v2):
+                            d, p = _read_varint(v2, p)
+                            dims.append(_signed(d))
+        elif field in (1, 2, 3, 4) and wire == 0:  # legacy n/c/h/w
+            legacy[field - 1] = val
+    arr = np.frombuffer(b"".join(chunks), "<f4").astype(np.float32)
+    if dims:
+        return arr.reshape(dims)  # shape field is authoritative
+    if any(v is not None for v in legacy):
+        arr = arr.reshape([v for v in legacy if v is not None])
+        # ONLY legacy dims carry redundant leading 1-dims (a bias is
+        # stored [1, 1, 1, N]); drop them so it lands 1-D/2-D.
+        # (Inherent legacy ambiguity: a conv kernel with num=1 output
+        # channels is indistinguishable from padding dims -- modern
+        # shape-field caffemodels are unaffected.)
+        while arr.ndim > 1 and arr.shape[0] == 1:
+            arr = arr[0]
+    return arr
+
+
+def import_caffe(path_or_bytes,
+                 key_map: Optional[Dict[str, str]] = None) -> Dict:
+    """``.caffemodel`` -> nested flax-style params dict
+    (ref: zoo/.../models/common/caffe CaffeLoader role -- the reference
+    executes caffe graphs via BigDL; here the weights import into the
+    JAX re-implementation). Handles both LayerParameter (new) and
+    V1LayerParameter (legacy) layer lists; blob 0 becomes ``kernel``
+    (OIHW -> HWIO for convs, [out, in] -> [in, out] for inner product),
+    blob 1 becomes ``bias``.
+    """
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    out: Dict = {}
+    found_layer = False
+    for field, _, val in _iter_fields(data):
+        if field not in (2, 100):  # layers (V1) / layer (new)
+            continue
+        found_layer = True
+        name_field = 4 if field == 2 else 1
+        name = ""
+        ltype = ""
+        blobs: List[np.ndarray] = []
+        for f2, _, v2 in _iter_fields(val):
+            if f2 == name_field and isinstance(v2, bytes):
+                name = v2.decode("utf-8", "replace")
+            elif field == 100 and f2 == 2 and isinstance(v2, bytes):
+                ltype = v2.decode("utf-8", "replace")
+            elif f2 == (6 if field == 2 else 7):
+                blobs.append(_parse_caffe_blob(v2))
+        if not name or not blobs:
+            continue
+        parts = _apply_key_map(name, key_map).split("/")
+        if ltype == "BatchNorm":
+            # blobs: mean-sum, variance-sum, moving-average factor; the
+            # stats are the sums divided by the factor
+            factor = float(blobs[2].reshape(-1)[0]) if len(blobs) > 2 \
+                else 1.0
+            factor = factor if factor != 0 else 1.0
+            _nest(out, parts, "mean", blobs[0] / factor)
+            _nest(out, parts, "var", blobs[1] / factor)
+        elif ltype == "Scale":
+            _nest(out, parts, "scale", blobs[0])
+            if len(blobs) > 1:
+                _nest(out, parts, "bias", blobs[1])
+        else:
+            if len(blobs) > 2:
+                raise ValueError(
+                    f"layer {name!r} ({ltype or 'V1'}) has "
+                    f"{len(blobs)} blobs; only BatchNorm/Scale "
+                    "multi-blob layers are understood")
+            leaf, kernel = _remap_torch_weight("weight", blobs[0], True)
+            _nest(out, parts, leaf, kernel)
+            if len(blobs) > 1:
+                _nest(out, parts, "bias", blobs[1])
+    if not found_layer:
+        raise ValueError("not a caffemodel (no layer fields)")
+    return out

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import io
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -146,6 +146,29 @@ class InferenceModel:
         self.variables = q_tree
         self._compiled.clear()
         self._quantized = True
+        return self
+
+    # ---------------------------------------------------------- warm-up --
+    def warm_up(self, example_input,
+                batch_sizes: Sequence[int] = (1, 8, 32)
+                ) -> "InferenceModel":
+        """Pre-compile the shape buckets a serving deployment will hit
+        (SURVEY.md section 7 step 7: AOT-compile per batch-shape), so the
+        first real request never pays the XLA compile. ``example_input``
+        is a single-sample (or any-size) batch pytree; each requested
+        batch size compiles its power-of-two bucket."""
+        if self._apply_fn is None:
+            raise RuntimeError("no model loaded")
+        example = jax.tree_util.tree_map(np.asarray, example_input)
+        done = set()
+        for bs in batch_sizes:
+            bucket = _bucket(bs)
+            if bucket in done:
+                continue
+            done.add(bucket)
+            batch = jax.tree_util.tree_map(
+                lambda a: np.repeat(a[:1], bucket, axis=0), example)
+            self.predict(batch)
         return self
 
     # ---------------------------------------------------------- predict --

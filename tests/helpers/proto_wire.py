@@ -1,0 +1,31 @@
+"""Shared protobuf wire-format writers for fixture construction (used
+by importer and data tests; the single place the test-side encoding
+lives)."""
+
+import numpy as np
+
+
+def varint(n: int) -> bytes:
+    n &= (1 << 64) - 1
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        out += bytes([b7 | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def field(num: int, wire: int, payload: bytes) -> bytes:
+    tag = varint((num << 3) | wire)
+    if wire == 2:
+        return tag + varint(len(payload)) + payload
+    return tag + payload
+
+
+def caffe_blob(arr) -> bytes:
+    """BlobProto with packed float data + shape field."""
+    arr = np.asarray(arr, "<f4")
+    b = field(5, 2, arr.tobytes())
+    shape = b"".join(field(1, 0, varint(d)) for d in arr.shape)
+    return b + field(7, 2, shape)

@@ -1,0 +1,83 @@
+"""Length-bucketing tests: bucket assignment, padding waste, and
+training a model across buckets with one Estimator."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.bucketing import (
+    SequenceBuckets, bucket_boundaries_for, fit_bucketed)
+
+
+def make_sequences(n, seed=0):
+    rng = np.random.RandomState(seed)
+    seqs, labels = [], []
+    for _ in range(n):
+        ln = int(rng.choice([5, 9, 20, 40]))
+        word = rng.randint(1, 50)
+        seqs.append(rng.randint(1, 50, ln))
+        labels.append(int(seqs[-1][0] % 2))
+    return seqs, labels
+
+
+class TestBoundaries:
+    def test_rounded_and_covering(self):
+        bounds = bucket_boundaries_for([3, 9, 17, 33, 64], n_buckets=3)
+        assert all(b % 8 == 0 for b in bounds)
+        assert bounds[-1] >= 64
+        assert bounds == sorted(set(bounds))
+
+
+class TestSequenceBuckets:
+    def test_assignment_and_shapes(self):
+        seqs, labels = make_sequences(64)
+        buckets = SequenceBuckets(seqs, labels,
+                                  boundaries=[8, 16, 48])
+        total = 0
+        for bound, x, y in buckets:
+            assert x.shape[1] == bound
+            assert len(x) == len(y)
+            total += len(x)
+        assert total == 64
+
+    def test_overlong_truncated_keep_tail(self):
+        seqs = [np.arange(1, 21)]  # length 20, bucket cap 8
+        buckets = SequenceBuckets(seqs, [0], boundaries=[8])
+        _, x, _ = next(iter(buckets))
+        np.testing.assert_array_equal(x[0], np.arange(13, 21))
+
+    def test_padding_waste_lower_than_single_bucket(self):
+        seqs, labels = make_sequences(128)
+        bucketed = SequenceBuckets(seqs, labels,
+                                   boundaries=[8, 16, 24, 40])
+        single = SequenceBuckets(seqs, labels, boundaries=[40])
+        assert bucketed.padding_waste < single.padding_waste
+
+    def test_datasets(self):
+        seqs, labels = make_sequences(32)
+        ds = SequenceBuckets(seqs, labels, boundaries=[16, 40]).datasets()
+        assert sum(d.num_samples for d in ds) == 32
+
+
+class TestFitBucketed:
+    def test_trains_across_buckets(self):
+        from analytics_zoo_tpu.keras.layers.transformer import (  # noqa
+            TransformerModule)
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.learn import Estimator
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, ids):
+                h = nn.Embed(50, 16)(ids.astype(jnp.int32))
+                h = jnp.mean(h, axis=1)
+                return nn.Dense(2)(h)
+
+        seqs, labels = make_sequences(256)
+        buckets = SequenceBuckets(seqs, labels, boundaries=[8, 16, 48])
+        est = Estimator(Net(), loss="sparse_categorical_crossentropy",
+                        optimizer="adam")
+        hist = fit_bucketed(est, buckets, batch_size=16, epochs=2)
+        assert len(hist) >= 2
+        assert all(np.isfinite(h["loss"]) for h in hist)

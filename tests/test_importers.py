@@ -165,6 +165,102 @@ class TestONNX:
         with pytest.raises(ValueError):
             import_onnx(b"\x12\x04abcd")
 
+
+from tests.helpers.proto_wire import (  # noqa: E402
+    caffe_blob as _caffe_blob, field as _field, varint as _varint)
+
+
+class TestCaffe:
+    def test_import_new_format_layers(self):
+        from analytics_zoo_tpu.inference.importers import import_caffe
+
+        rng = np.random.RandomState(0)
+        w = rng.randn(6, 4).astype(np.float32)   # [out, in]
+        bias = rng.randn(6).astype(np.float32)
+        conv = rng.randn(8, 3, 3, 3).astype(np.float32)  # OIHW
+        layer1 = (_field(1, 2, b"fc1") + _field(2, 2, b"InnerProduct")
+                  + _field(7, 2, _caffe_blob(w))
+                  + _field(7, 2, _caffe_blob(bias)))
+        layer2 = (_field(1, 2, b"conv1") + _field(2, 2, b"Convolution")
+                  + _field(7, 2, _caffe_blob(conv)))
+        net = (_field(1, 2, b"testnet") + _field(100, 2, layer1)
+               + _field(100, 2, layer2))
+        params = import_caffe(net)
+        np.testing.assert_allclose(params["fc1"]["kernel"], w.T)
+        np.testing.assert_allclose(params["fc1"]["bias"], bias)
+        assert params["conv1"]["kernel"].shape == (3, 3, 3, 8)  # HWIO
+
+    def test_import_legacy_v1_layers(self):
+        from analytics_zoo_tpu.inference.importers import import_caffe
+
+        w = np.arange(12, dtype=np.float32).reshape(3, 4)
+        layer = (_field(4, 2, b"ip") + _field(6, 2, _caffe_blob(w)))
+        net = _field(2, 2, layer)
+        params = import_caffe(net)
+        np.testing.assert_allclose(params["ip"]["kernel"], w.T)
+
+    def test_rejects_non_caffe(self):
+        from analytics_zoo_tpu.inference.importers import import_caffe
+
+        with pytest.raises(ValueError):
+            import_caffe(_field(1, 2, b"just-a-name"))
+
+    def test_single_output_conv_keeps_rank(self):
+        from analytics_zoo_tpu.inference.importers import import_caffe
+
+        conv = np.arange(2 * 3 * 3, dtype=np.float32).reshape(1, 2, 3, 3)
+        layer = (_field(1, 2, b"mask") + _field(2, 2, b"Convolution")
+                 + _field(7, 2, _caffe_blob(conv)))
+        params = import_caffe(_field(100, 2, layer))
+        assert params["mask"]["kernel"].shape == (3, 3, 2, 1)  # HWIO
+
+    def test_batchnorm_and_scale_layers(self):
+        from analytics_zoo_tpu.inference.importers import import_caffe
+
+        mean = np.asarray([2.0, 4.0], np.float32)
+        var = np.asarray([1.0, 9.0], np.float32)
+        factor = np.asarray([2.0], np.float32)
+        bn = (_field(1, 2, b"bn1") + _field(2, 2, b"BatchNorm")
+              + _field(7, 2, _caffe_blob(mean))
+              + _field(7, 2, _caffe_blob(var))
+              + _field(7, 2, _caffe_blob(factor)))
+        gamma = np.asarray([1.5, 0.5], np.float32)
+        beta = np.asarray([0.1, -0.1], np.float32)
+        sc = (_field(1, 2, b"scale1") + _field(2, 2, b"Scale")
+              + _field(7, 2, _caffe_blob(gamma))
+              + _field(7, 2, _caffe_blob(beta)))
+        params = import_caffe(_field(100, 2, bn) + _field(100, 2, sc))
+        np.testing.assert_allclose(params["bn1"]["mean"], mean / 2.0)
+        np.testing.assert_allclose(params["bn1"]["var"], var / 2.0)
+        np.testing.assert_allclose(params["scale1"]["scale"], gamma)
+        np.testing.assert_allclose(params["scale1"]["bias"], beta)
+
+    def test_unknown_multiblob_layer_raises(self):
+        from analytics_zoo_tpu.inference.importers import import_caffe
+
+        b1 = _caffe_blob(np.zeros(2, np.float32))
+        layer = (_field(1, 2, b"odd") + _field(2, 2, b"Mystery")
+                 + _field(7, 2, b1) + _field(7, 2, b1)
+                 + _field(7, 2, b1))
+        with pytest.raises(ValueError, match="blobs"):
+            import_caffe(_field(100, 2, layer))
+
+    def test_legacy_bias_squeezes_to_1d(self):
+        from analytics_zoo_tpu.inference.importers import import_caffe
+        from tests.helpers.proto_wire import field, varint
+
+        # legacy dims [1, 1, 1, 5] bias with no shape message
+        bias = np.arange(5, dtype=np.float32)
+        blob = field(5, 2, bias.astype("<f4").tobytes())
+        for num, v in zip((1, 2, 3, 4), (1, 1, 1, 5)):
+            blob += field(num, 0, varint(v))
+        layer = (_field(4, 2, b"ip2") + _field(6, 2, blob))
+        params = import_caffe(_field(2, 2, layer))
+        # a lone 1-D blob lands as 'scale' (PReLU-slope style)
+        assert params["ip2"]["scale"].shape == (5,)
+
+
+class TestONNXEdgeCases:
     def test_negative_int64_data_varints(self):
         # negative ints ride 10-byte two's-complement varints
         def varint64(n):

@@ -78,6 +78,21 @@ class TestInferenceModel:
         np.testing.assert_allclose(out, np.asarray(net.apply(variables, x)),
                                    atol=1e-6)
 
+    def test_warm_up_precompiles_buckets(self):
+        import jax
+
+        net = SmallNet()
+        x = np.random.RandomState(0).randn(1, 6).astype(np.float32)
+        variables = net.init(jax.random.PRNGKey(0), x)
+        inf = InferenceModel().load_flax(net, variables=variables)
+        inf.warm_up(x, batch_sizes=(1, 3, 8))
+        # buckets 1, 4, 8 are compiled (3 -> 4); serving sizes hit the
+        # cache without further compiles
+        assert len(inf._compiled) == 3
+        before = set(inf._compiled)
+        inf.predict(np.random.randn(5, 6).astype(np.float32))  # ->8
+        assert set(inf._compiled) == before
+
     def test_quantize_close_to_fp(self):
         import jax
 
