@@ -144,6 +144,7 @@ class Estimator:
         self.epoch = 0
         self._train_step = None
         self._eval_step = None
+        self._epoch_fns: Dict[Any, Callable] = {}
         self._predict_fns: Dict[Any, Callable] = {}
         self._rng = jax.random.PRNGKey(seed)
 
@@ -204,39 +205,83 @@ class Estimator:
                                           self.param_spec_fn)
 
     # -------------------------------------------------------- train step --
+    def _step_math(self, variables, opt_state, x, y, rng):
+        """One SGD update; shared by the per-step and the device-cached
+        whole-epoch paths."""
+        import optax
+
+        adapter, loss_fn, tx = self.adapter, self.loss_fn, self.tx
+        params = variables.get("params", {})
+        extra = {k: v for k, v in variables.items() if k != "params"}
+
+        def compute_loss(p):
+            preds, new_extra = adapter.apply(
+                {"params": p, **extra}, x, training=True, rng=rng)
+            return loss_fn(preds, y), new_extra
+
+        (loss, new_extra), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return {"params": params, **new_extra}, opt_state, loss
+
     def _build_train_step(self):
         if self._train_step is not None:
             return self._train_step
         if self.loss_fn is None:
             raise ValueError("Estimator needs a loss to train")
-        adapter, loss_fn, tx = self.adapter, self.loss_fn, self.tx
         donate = get_config().get("zoo.train.donate_buffers")
 
         def step(variables, opt_state, loss_sum, x, y, rng):
-            params = variables.get("params", {})
-            extra = {k: v for k, v in variables.items() if k != "params"}
-
-            def compute_loss(p):
-                preds, new_extra = adapter.apply(
-                    {"params": p, **extra}, x, training=True, rng=rng)
-                return loss_fn(preds, y), new_extra
-
-            (loss, new_extra), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(params)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            import optax
-
-            params = optax.apply_updates(params, updates)
+            variables, opt_state, loss = self._step_math(
+                variables, opt_state, x, y, rng)
             # the epoch loss accumulates ON DEVICE: pulling per-step
             # scalars to host costs a full round-trip each (catastrophic
             # over remote dispatch links); the epoch mean is one
             # transfer of this resident scalar
-            return ({"params": params, **new_extra}, opt_state,
-                    loss_sum + loss, loss)
+            return variables, opt_state, loss_sum + loss, loss
 
         self._train_step = jax.jit(
             step, donate_argnums=(0, 1, 2) if donate else ())
         return self._train_step
+
+    def _build_epoch_fn(self, batch_size: int, n_steps: int):
+        """Whole-epoch train function for device-resident datasets: ONE
+        dispatch runs ``n_steps`` updates via ``lax.fori_loop``, gathering
+        each shuffled batch on device. Where the reference runs two Spark
+        jobs per ITERATION (Topology.scala:1193+), this runs one XLA
+        program per EPOCH -- no host round-trips inside."""
+        from jax.sharding import NamedSharding
+
+        mesh = self.mesh
+
+        def epoch(variables, opt_state, x_all, y_all, perm, rng0):
+            def body(i, carry):
+                variables, opt_state, loss_sum = carry
+                idx = jax.lax.dynamic_slice_in_dim(
+                    perm, i * batch_size, batch_size)
+
+                def take(a):
+                    b = jnp.take(a, idx, axis=0)
+                    return jax.lax.with_sharding_constraint(
+                        b, NamedSharding(
+                            mesh, sharding.data_parallel_spec(b)))
+
+                x = jax.tree_util.tree_map(take, x_all)
+                y = (jax.tree_util.tree_map(take, y_all)
+                     if y_all is not None else None)
+                rng = jax.random.fold_in(rng0, i)
+                variables, opt_state, loss = self._step_math(
+                    variables, opt_state, x, y, rng)
+                return variables, opt_state, loss_sum + loss
+
+            init = (variables, opt_state, jnp.zeros((), jnp.float32))
+            variables, opt_state, loss_sum = jax.lax.fori_loop(
+                0, n_steps, body, init)
+            return variables, opt_state, loss_sum / n_steps
+
+        donate = get_config().get("zoo.train.donate_buffers")
+        return jax.jit(epoch, donate_argnums=(0, 1) if donate else ())
 
     def _eval_metrics(self) -> List[Metric]:
         """The tracked metrics plus a Loss metric when a loss is set."""
@@ -267,7 +312,8 @@ class Estimator:
             checkpoint_dir: Optional[str] = None,
             checkpoint_trigger: Optional[Trigger] = None,
             log_dir: Optional[str] = None,
-            resume: bool = False) -> List[Dict[str, float]]:
+            resume: bool = False,
+            device_cache: bool = False) -> List[Dict[str, float]]:
         """Train; returns per-epoch history.
 
         Failure semantics mirror InternalDistriOptimizer.train
@@ -275,6 +321,12 @@ class Estimator:
         checkpoint exists and fewer than ``zoo.train.failure.retry_times``
         failures occurred within ``zoo.train.failure.retry_interval_s``,
         restore the latest snapshot and continue.
+
+        ``device_cache=True`` places the whole dataset in device memory
+        once and compiles each epoch into a single XLA program (shuffled
+        batches gathered on device) -- the fast path for datasets that
+        fit in HBM. Triggers/validation/checkpoints then run at epoch
+        granularity, and single-process only.
         """
         cfg = get_config()
         dataset = _as_dataset(data)
@@ -286,13 +338,17 @@ class Estimator:
         if resume and checkpoint_dir and \
                 ckpt_lib.latest_step(checkpoint_dir) is not None:
             self._restore(checkpoint_dir)
+        if device_cache:
+            if jax.process_count() > 1:
+                raise ValueError("device_cache supports single-process "
+                                 "runs only")
+            return self._fit_device_cached(
+                dataset, val_dataset, batch_size, epochs,
+                validation_trigger, checkpoint_trigger, checkpoint_dir,
+                log_dir)
 
         train_step = self._build_train_step()
-        writer = None
-        if log_dir is not None:
-            from analytics_zoo_tpu.utils.summary import SummaryWriter
-
-            writer = SummaryWriter(log_dir)
+        writer = self._make_writer(log_dir)
 
         log_every = cfg.get("zoo.train.log_every_n_steps")
         retry_times = cfg.get("zoo.train.failure.retry_times")
@@ -400,6 +456,133 @@ class Estimator:
                 state.loss = None
                 state.score = None
                 self._restore(checkpoint_dir)
+        return history
+
+    @staticmethod
+    def _make_writer(log_dir: Optional[str]):
+        if log_dir is None:
+            return None
+        from analytics_zoo_tpu.utils.summary import SummaryWriter
+
+        return SummaryWriter(log_dir)
+
+    @staticmethod
+    def _fired_in_range(trigger: Trigger, state: TriggerState,
+                        start_step: int, end_step: int) -> bool:
+        """Whether ``trigger`` would have fired at ANY step in
+        (start_step, end_step] -- the cached path checks triggers once
+        per epoch, so step-granular triggers (SeveralIteration) must
+        scan the epoch's step range instead of testing only the final
+        step (which is always a multiple of steps-per-epoch)."""
+        saved = state.iteration
+        try:
+            for it in range(start_step + 1, end_step + 1):
+                state.iteration = it
+                if trigger(state):
+                    return True
+            return False
+        finally:
+            state.iteration = saved
+
+    def _fit_device_cached(self, dataset, val_dataset, batch_size,
+                           epochs, validation_trigger, checkpoint_trigger,
+                           checkpoint_dir, log_dir
+                           ) -> List[Dict[str, float]]:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg = get_config()
+        n = dataset.num_samples
+        n_steps = n // batch_size
+        if n_steps == 0:
+            raise ValueError(f"dataset ({n} samples) smaller than "
+                             f"batch_size {batch_size}")
+        rep = NamedSharding(self.mesh, P())
+        x_all = jax.device_put(
+            jax.tree_util.tree_map(np.asarray, dataset.features), rep)
+        y_all = (jax.device_put(
+            jax.tree_util.tree_map(np.asarray, dataset.labels), rep)
+            if dataset.labels is not None else None)
+        key = (batch_size, n_steps)
+        epoch_fn = self._epoch_fns.get(key)
+        if epoch_fn is None:
+            epoch_fn = self._build_epoch_fn(batch_size, n_steps)
+            self._epoch_fns[key] = epoch_fn
+        writer = self._make_writer(log_dir)
+        history: List[Dict[str, float]] = []
+        state = TriggerState(epoch=self.epoch, iteration=self.global_step)
+        perm_rng = np.random.RandomState(
+            (self.seed * 7919 + self.epoch) & 0x7FFFFFFF)
+        retry_times = cfg.get("zoo.train.failure.retry_times")
+        retry_interval = cfg.get("zoo.train.failure.retry_interval_s")
+        failures: List[float] = []
+        try:
+            while self.epoch < epochs:
+                t0 = time.time()
+                step_before = self.global_step
+                try:
+                    perm = jax.device_put(
+                        perm_rng.permutation(n)[:n_steps * batch_size]
+                        .astype(np.int32), rep)
+                    self._rng, erng = jax.random.split(self._rng)
+                    self.variables, self.opt_state, mean_loss = epoch_fn(
+                        self.variables, self.opt_state, x_all, y_all,
+                        perm, erng)
+                    lf = float(mean_loss)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    # same retry-from-checkpoint contract as the
+                    # per-step loop (ref: Topology.scala:1255-1332)
+                    now = time.time()
+                    failures[:] = [t for t in failures
+                                   if now - t < retry_interval] + [now]
+                    can_retry = (checkpoint_dir is not None and
+                                 ckpt_lib.latest_step(checkpoint_dir)
+                                 is not None and
+                                 len(failures) <= retry_times)
+                    logger.exception(
+                        "training failure %d/%d in window: %s",
+                        len(failures), retry_times, e)
+                    if not can_retry:
+                        raise
+                    state.loss = None
+                    state.score = None
+                    self._restore(checkpoint_dir)
+                    continue
+                self.epoch += 1
+                self.global_step += n_steps
+                entry: Dict[str, float] = {
+                    "epoch": self.epoch, "loss": lf,
+                    "seconds": time.time() - t0}
+                state.epoch = self.epoch
+                state.iteration = self.global_step
+                state.loss = lf
+                state.epoch_finished = True
+                state.wall_time = time.time()
+                if writer:
+                    writer.add_scalar("train/loss", lf, self.global_step)
+                if val_dataset is not None and self._fired_in_range(
+                        validation_trigger, state, step_before,
+                        self.global_step):
+                    val = self.evaluate(val_dataset, batch_size)
+                    state.score = next(iter(val.values()), None)
+                    entry.update({f"val_{k}": v for k, v in val.items()})
+                    if writer:
+                        for k, v in val.items():
+                            writer.add_scalar(f"validation/{k}", v,
+                                              self.global_step)
+                if checkpoint_dir is not None and self._fired_in_range(
+                        checkpoint_trigger, state, step_before,
+                        self.global_step):
+                    ckpt_lib.save_checkpoint(
+                        checkpoint_dir, self.variables, self.opt_state,
+                        self.global_step, self.epoch)
+                history.append(entry)
+                logger.info("epoch %d done (device-cached): %s",
+                            self.epoch, entry)
+        finally:
+            if writer:
+                writer.close()
         return history
 
     def _restore(self, checkpoint_dir: str) -> None:
