@@ -97,6 +97,10 @@ class Timer:
         with self._lock:
             return self._stats.get(name)
 
+    def stats(self) -> Dict[str, TimerStat]:
+        with self._lock:
+            return dict(self._stats)
+
     def summaries(self) -> List[str]:
         with self._lock:
             return [s.summary() for s in self._stats.values()]

@@ -146,6 +146,7 @@ class Estimator:
         self._eval_step = None
         self._epoch_fns: Dict[Any, Callable] = {}
         self._predict_fns: Dict[Any, Callable] = {}
+        self.last_profile = None  # set by fit(profile=True)
         self._rng = jax.random.PRNGKey(seed)
 
     # ------------------------------------------------------------- setup --
@@ -313,7 +314,9 @@ class Estimator:
             checkpoint_trigger: Optional[Trigger] = None,
             log_dir: Optional[str] = None,
             resume: bool = False,
-            device_cache: bool = False) -> List[Dict[str, float]]:
+            device_cache: bool = False,
+            profile: bool = False,
+            trace_dir: Optional[str] = None) -> List[Dict[str, float]]:
         """Train; returns per-epoch history.
 
         Failure semantics mirror InternalDistriOptimizer.train
@@ -327,6 +330,12 @@ class Estimator:
         batches gathered on device) -- the fast path for datasets that
         fit in HBM. Triggers/validation/checkpoints then run at epoch
         granularity, and single-process only.
+
+        ``profile=True`` records data-wait vs step-dispatch stage timers
+        into ``self.last_profile`` (a ``TrainingProfiler``; the Ray
+        runners' profile=True analog, ref: pytorch_ray_estimator.py:
+        150-190); ``trace_dir`` additionally captures a jax.profiler
+        device trace viewable in TensorBoard.
         """
         cfg = get_config()
         dataset = _as_dataset(data)
@@ -338,40 +347,59 @@ class Estimator:
         if resume and checkpoint_dir and \
                 ckpt_lib.latest_step(checkpoint_dir) is not None:
             self._restore(checkpoint_dir)
-        if device_cache:
-            if jax.process_count() > 1:
-                raise ValueError("device_cache supports single-process "
-                                 "runs only")
-            return self._fit_device_cached(
-                dataset, val_dataset, batch_size, epochs,
-                validation_trigger, checkpoint_trigger, checkpoint_dir,
-                log_dir)
+        profiler = None
+        if profile or trace_dir:
+            from analytics_zoo_tpu.learn.profiler import TrainingProfiler
 
-        train_step = self._build_train_step()
-        writer = self._make_writer(log_dir)
-
-        log_every = cfg.get("zoo.train.log_every_n_steps")
-        retry_times = cfg.get("zoo.train.failure.retry_times")
-        retry_interval = cfg.get("zoo.train.failure.retry_interval_s")
-        failures: List[float] = []
-        history: List[Dict[str, float]] = []
-        state = TriggerState(epoch=self.epoch, iteration=self.global_step)
-        steps_per_epoch = dataset.steps_per_epoch(batch_size)
+            profiler = TrainingProfiler(trace_dir=trace_dir)
+            self.last_profile = profiler
+            profiler.start_trace()
         try:
-            return self._fit_loop(
-                dataset, val_dataset, batch_size, epochs, train_step,
-                writer, log_every, retry_times, retry_interval,
-                validation_trigger, checkpoint_trigger, checkpoint_dir,
-                failures, history, state, steps_per_epoch)
+            if device_cache:
+                if jax.process_count() > 1:
+                    raise ValueError("device_cache supports "
+                                     "single-process runs only")
+                return self._fit_device_cached(
+                    dataset, val_dataset, batch_size, epochs,
+                    validation_trigger, checkpoint_trigger,
+                    checkpoint_dir, log_dir, profiler)
+
+            train_step = self._build_train_step()
+            writer = self._make_writer(log_dir)
+            log_every = cfg.get("zoo.train.log_every_n_steps")
+            retry_times = cfg.get("zoo.train.failure.retry_times")
+            retry_interval = cfg.get("zoo.train.failure.retry_interval_s")
+            failures: List[float] = []
+            history: List[Dict[str, float]] = []
+            state = TriggerState(epoch=self.epoch,
+                                 iteration=self.global_step)
+            steps_per_epoch = dataset.steps_per_epoch(batch_size)
+            try:
+                return self._fit_loop(
+                    dataset, val_dataset, batch_size, epochs, train_step,
+                    writer, log_every, retry_times, retry_interval,
+                    validation_trigger, checkpoint_trigger,
+                    checkpoint_dir, failures, history, state,
+                    steps_per_epoch, profiler)
+            finally:
+                if writer:
+                    writer.close()
         finally:
-            if writer:
-                writer.close()
+            if profiler is not None:
+                profiler.stop_trace()
+                logger.info("training profile: %s", profiler.summary())
 
     def _fit_loop(self, dataset, val_dataset, batch_size, epochs,
                   train_step, writer, log_every, retry_times,
                   retry_interval, validation_trigger, checkpoint_trigger,
                   checkpoint_dir, failures, history, state,
-                  steps_per_epoch) -> List[Dict[str, float]]:
+                  steps_per_epoch, profiler=None
+                  ) -> List[Dict[str, float]]:
+        import contextlib
+
+        def stage(name):
+            return (profiler.timing(name) if profiler is not None
+                    else contextlib.nullcontext())
 
         while self.epoch < epochs:
             epoch_start = time.time()
@@ -379,14 +407,21 @@ class Estimator:
             n_steps = 0
             last_val: Optional[Dict[str, float]] = None
             try:
-                for step_in_epoch, (x, y) in enumerate(
-                        dataset.device_iterator(
-                            batch_size, mesh=self.mesh, shuffle=True,
-                            seed=self.seed, epoch=self.epoch)):
+                batches = iter(dataset.device_iterator(
+                    batch_size, mesh=self.mesh, shuffle=True,
+                    seed=self.seed, epoch=self.epoch))
+                for step_in_epoch in range(steps_per_epoch):
+                    with stage("data_wait"):
+                        try:
+                            x, y = next(batches)
+                        except StopIteration:
+                            break
                     self._rng, step_rng = jax.random.split(self._rng)
-                    (self.variables, self.opt_state, loss_sum,
-                     loss) = train_step(self.variables, self.opt_state,
-                                        loss_sum, x, y, step_rng)
+                    with stage("train_step"):
+                        (self.variables, self.opt_state, loss_sum,
+                         loss) = train_step(self.variables,
+                                            self.opt_state, loss_sum,
+                                            x, y, step_rng)
                     self.global_step += 1
                     n_steps += 1
                     if (self.global_step % log_every == 0 or
@@ -439,24 +474,37 @@ class Estimator:
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
-                now = time.time()
-                failures[:] = [t for t in failures
-                               if now - t < retry_interval] + [now]
-                can_retry = (checkpoint_dir is not None and
-                             ckpt_lib.latest_step(checkpoint_dir) is not None
-                             and len(failures) <= retry_times)
-                logger.exception(
-                    "training failure %d/%d in window: %s",
-                    len(failures), retry_times, e)
-                if not can_retry:
+                if not self._handle_training_failure(
+                        e, failures, retry_times, retry_interval,
+                        checkpoint_dir, state):
                     raise
-                # the restored model's loss/score are unknown until the
-                # next log step / validation; stale pre-crash values
-                # would misfire MinLoss/MaxScore
-                state.loss = None
-                state.score = None
-                self._restore(checkpoint_dir)
         return history
+
+    def _handle_training_failure(self, e, failures, retry_times,
+                                 retry_interval, checkpoint_dir,
+                                 state) -> bool:
+        """Shared retry-from-checkpoint contract for both fit loops
+        (ref: Topology.scala:1255-1332): prune the failure window, and
+        if a checkpoint exists within the retry budget, reset stale
+        trigger state and restore. Returns whether training continues
+        (False -> caller re-raises)."""
+        now = time.time()
+        failures[:] = [t for t in failures
+                       if now - t < retry_interval] + [now]
+        can_retry = (checkpoint_dir is not None and
+                     ckpt_lib.latest_step(checkpoint_dir) is not None
+                     and len(failures) <= retry_times)
+        logger.exception("training failure %d/%d in window: %s",
+                         len(failures), retry_times, e)
+        if not can_retry:
+            return False
+        # the restored model's loss/score are unknown until the next
+        # log step / validation; stale pre-crash values would misfire
+        # MinLoss/MaxScore
+        state.loss = None
+        state.score = None
+        self._restore(checkpoint_dir)
+        return True
 
     @staticmethod
     def _make_writer(log_dir: Optional[str]):
@@ -486,9 +534,15 @@ class Estimator:
 
     def _fit_device_cached(self, dataset, val_dataset, batch_size,
                            epochs, validation_trigger, checkpoint_trigger,
-                           checkpoint_dir, log_dir
+                           checkpoint_dir, log_dir, profiler=None
                            ) -> List[Dict[str, float]]:
+        import contextlib
+
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def stage(name):
+            return (profiler.timing(name) if profiler is not None
+                    else contextlib.nullcontext())
 
         cfg = get_config()
         n = dataset.num_samples
@@ -520,34 +574,25 @@ class Estimator:
                 t0 = time.time()
                 step_before = self.global_step
                 try:
-                    perm = jax.device_put(
-                        perm_rng.permutation(n)[:n_steps * batch_size]
-                        .astype(np.int32), rep)
+                    with stage("data_wait"):
+                        perm = jax.device_put(
+                            perm_rng.permutation(n)
+                            [:n_steps * batch_size].astype(np.int32),
+                            rep)
                     self._rng, erng = jax.random.split(self._rng)
-                    self.variables, self.opt_state, mean_loss = epoch_fn(
-                        self.variables, self.opt_state, x_all, y_all,
-                        perm, erng)
-                    lf = float(mean_loss)
+                    with stage("train_step"):
+                        (self.variables, self.opt_state,
+                         mean_loss) = epoch_fn(
+                            self.variables, self.opt_state, x_all,
+                            y_all, perm, erng)
+                        lf = float(mean_loss)
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except Exception as e:
-                    # same retry-from-checkpoint contract as the
-                    # per-step loop (ref: Topology.scala:1255-1332)
-                    now = time.time()
-                    failures[:] = [t for t in failures
-                                   if now - t < retry_interval] + [now]
-                    can_retry = (checkpoint_dir is not None and
-                                 ckpt_lib.latest_step(checkpoint_dir)
-                                 is not None and
-                                 len(failures) <= retry_times)
-                    logger.exception(
-                        "training failure %d/%d in window: %s",
-                        len(failures), retry_times, e)
-                    if not can_retry:
+                    if not self._handle_training_failure(
+                            e, failures, retry_times, retry_interval,
+                            checkpoint_dir, state):
                         raise
-                    state.loss = None
-                    state.score = None
-                    self._restore(checkpoint_dir)
                     continue
                 self.epoch += 1
                 self.global_step += n_steps

@@ -1,0 +1,91 @@
+"""Training-side profiling.
+
+Round-1 gap (VERDICT row 29): the reference profiles serving via
+``Timer`` and training via BigDL ``Metrics`` counters + Ray runners'
+``profile=True`` per-epoch time stats
+(ref: zoo/.../serving/engine/Timer.scala:24-90,
+pyzoo/zoo/orca/learn/pytorch/pytorch_ray_estimator.py:150-190,
+torch_runner.py:308-316). Here training profiling has two layers:
+
+- ``TrainingProfiler``: host-side stage timers (data wait vs step
+  dispatch vs epoch wall time) with the same count/avg/max/min summary
+  shape as the serving Timer -- answers "am I input-bound?".
+- XLA device tracing: ``jax.profiler`` traces written to a TensorBoard
+  -loadable directory when ``trace_dir`` is set -- answers "what is the
+  chip doing?" (the reference has no analog; BigDL had no device
+  profiler).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional
+
+from analytics_zoo_tpu.common.log import Timer
+
+
+class TrainingProfiler:
+    """Stage timers + optional jax.profiler trace for one fit() run."""
+
+    def __init__(self, trace_dir: Optional[str] = None):
+        self.timer = Timer()
+        self.trace_dir = trace_dir
+        self._tracing = False
+
+    # ------------------------------------------------------ stage timing --
+    @contextlib.contextmanager
+    def timing(self, stage: str):
+        """Host timer for the stage; while a device trace is active the
+        stage also appears as a named region on the trace timeline."""
+        with self.timer.timing(stage):
+            if self._tracing:
+                with self.step_annotation(stage):
+                    yield
+            else:
+                yield
+
+    # ------------------------------------------------------- device trace --
+    def start_trace(self) -> None:
+        if self.trace_dir and not self._tracing:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self._tracing = True
+
+    def stop_trace(self) -> None:
+        if self._tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    @contextlib.contextmanager
+    def step_annotation(self, name: str):
+        """Named region visible in the device trace timeline."""
+        import jax
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+    # ----------------------------------------------------------- results --
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, stat in self.timer.stats().items():
+            out[name] = {"count": stat.count,
+                         "total_s": round(stat.total, 6),
+                         "avg_s": round(stat.avg, 6),
+                         "max_s": round(stat.max, 6),
+                         "min_s": round(stat.min if stat.count else 0.0,
+                                        6)}
+        return out
+
+    @property
+    def input_bound_fraction(self) -> Optional[float]:
+        """Fraction of loop time spent waiting on data -- > ~0.3 means
+        the input pipeline, not the chip, sets throughput."""
+        stats = self.timer.stats()
+        data = stats.get("data_wait")
+        step = stats.get("train_step")
+        if not data or not step or (data.total + step.total) == 0:
+            return None
+        return data.total / (data.total + step.total)

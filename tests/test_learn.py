@@ -317,3 +317,43 @@ class TestDeviceCachedFit:
         fn_first = est._epoch_fns[(64, 8)]
         est.fit((x, y), batch_size=64, epochs=2, device_cache=True)
         assert est._epoch_fns[(64, 8)] is fn_first
+
+
+class TestTrainingProfiler:
+    def test_profile_records_stage_timers(self):
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(2)(x)
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(256, 4).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        est = Estimator(Net(), loss="sparse_categorical_crossentropy")
+        est.fit((x, y), batch_size=64, epochs=2, profile=True)
+        prof = est.last_profile
+        summary = prof.summary()
+        assert "data_wait" in summary and "train_step" in summary
+        assert summary["train_step"]["count"] == 2 * (256 // 64)
+        frac = prof.input_bound_fraction
+        assert frac is not None and 0.0 <= frac <= 1.0
+
+    def test_profile_composes_with_device_cache(self):
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(2)(x)
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(256, 4).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        est = Estimator(Net(), loss="sparse_categorical_crossentropy")
+        assert est.last_profile is None
+        est.fit((x, y), batch_size=64, epochs=2, device_cache=True,
+                profile=True)
+        summary = est.last_profile.summary()
+        assert summary["train_step"]["count"] == 2  # one per epoch
