@@ -16,6 +16,8 @@ mirrors InternalDistriOptimizer.train (ref: Topology.scala:1255-1332).
 
 from __future__ import annotations
 
+import contextlib
+import functools
 import inspect
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -60,6 +62,13 @@ def _call_args(x) -> tuple:
     if isinstance(x, tuple):
         return x
     return (x,)
+
+
+def _stage(profiler, name: str):
+    """Profiler stage context (nullcontext when profiling is off)."""
+    if profiler is not None:
+        return profiler.timing(name)
+    return contextlib.nullcontext()
 
 
 class FlaxModelAdapter:
@@ -395,11 +404,7 @@ class Estimator:
                   checkpoint_dir, failures, history, state,
                   steps_per_epoch, profiler=None
                   ) -> List[Dict[str, float]]:
-        import contextlib
-
-        def stage(name):
-            return (profiler.timing(name) if profiler is not None
-                    else contextlib.nullcontext())
+        stage = functools.partial(_stage, profiler)
 
         while self.epoch < epochs:
             epoch_start = time.time()
@@ -536,13 +541,9 @@ class Estimator:
                            epochs, validation_trigger, checkpoint_trigger,
                            checkpoint_dir, log_dir, profiler=None
                            ) -> List[Dict[str, float]]:
-        import contextlib
-
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        def stage(name):
-            return (profiler.timing(name) if profiler is not None
-                    else contextlib.nullcontext())
+        stage = functools.partial(_stage, profiler)
 
         cfg = get_config()
         n = dataset.num_samples

@@ -1,0 +1,205 @@
+"""Native host-side kernels: build-on-first-use C library + ctypes.
+
+The compute path is XLA/Pallas; this is the native RUNTIME surface the
+reference keeps in its zoo-core artifacts (SURVEY.md section 2.4) --
+host-side IO hot loops. ``cc -O3`` compiles ``zoo_native.c`` into a
+per-user 0700 cache keyed by source hash; every entry point has a
+pure-Python fallback, so the framework works without a compiler.
+
+API:
+- ``available() -> bool``     (blocks for the one-time build)
+- ``ready() -> bool``         (non-blocking; kicks the build off in the
+  background -- hot paths use this so the first call never stalls)
+- ``crc32c(data: bytes) -> int``           (Castagnoli, slicing-by-8)
+- ``scan_tfrecords(buf, verify=False) -> list[(offset, length)]``
+  (``buf``: bytes or any writable buffer, e.g. an ACCESS_COPY mmap)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import stat
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "zoo_native.c")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_thread: Optional[threading.Thread] = None
+_done = threading.Event()
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("ZOO_NATIVE_CACHE")
+    if base is None:
+        base = os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.expanduser("~/.cache")),
+            "analytics_zoo_tpu")
+    os.makedirs(base, mode=0o700, exist_ok=True)
+    os.chmod(base, 0o700)
+    return base
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    cache = _cache_dir()
+    so_path = os.path.join(cache, f"zoo_native_{tag}.so")
+    if not os.path.isfile(so_path):
+        tmp = so_path + f".build{os.getpid()}"
+        for cc in ("cc", "gcc", "clang"):
+            try:
+                r = subprocess.run(
+                    [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                    capture_output=True, timeout=120)
+            except (FileNotFoundError, subprocess.TimeoutExpired):
+                continue
+            if r.returncode == 0:
+                os.replace(tmp, so_path)
+                break
+        else:
+            return None
+    # refuse to load a library this user doesn't own (the cache dir is
+    # 0700, but ZOO_NATIVE_CACHE may point anywhere)
+    st = os.stat(so_path)
+    if st.st_uid != os.getuid() or (st.st_mode & stat.S_IWOTH):
+        return None
+    lib = ctypes.CDLL(so_path)
+    lib.zoo_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.zoo_crc32c.restype = ctypes.c_uint32
+    lib.zoo_scan_tfrecords.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, ctypes.c_int]
+    lib.zoo_scan_tfrecords.restype = ctypes.c_int64
+    return lib
+
+
+def _builder() -> None:
+    global _lib
+    try:
+        _lib = _build_and_load()
+    except Exception:
+        _lib = None
+    finally:
+        _done.set()
+
+
+def _kick() -> None:
+    global _build_thread
+    with _lock:
+        if _build_thread is None:
+            _build_thread = threading.Thread(target=_builder,
+                                             daemon=True)
+            _build_thread.start()
+
+
+def ready() -> bool:
+    """Non-blocking: True once the native library is loaded. The first
+    call starts the build in the background; hot paths (event writer)
+    use the Python fallback until it completes."""
+    _kick()
+    return _done.is_set() and _lib is not None
+
+
+def available() -> bool:
+    """Blocking: waits for the one-time build, then reports it."""
+    _kick()
+    _done.wait()
+    return _lib is not None
+
+
+def _as_ptr(buf):
+    """(void*, keepalive) for bytes or any buffer-protocol object."""
+    if isinstance(buf, (bytes, bytearray)):
+        keep = ctypes.create_string_buffer(bytes(buf), len(buf)) \
+            if isinstance(buf, bytearray) else buf
+        return ctypes.cast(ctypes.c_char_p(keep), ctypes.c_void_p), keep
+    view = (ctypes.c_ubyte * len(buf)).from_buffer(buf)
+    return ctypes.cast(view, ctypes.c_void_p), view
+
+
+def crc32c(data: bytes) -> int:
+    if available():
+        ptr, keep = _as_ptr(data)
+        out = int(_lib.zoo_crc32c(ptr, len(data)))
+        del keep
+        return out
+    from analytics_zoo_tpu.utils.summary import crc32c as py_crc32c
+
+    return py_crc32c(data)
+
+
+def crc32c_if_ready(data: bytes) -> Optional[int]:
+    """Native crc32c when the library is ready, else None (caller uses
+    its Python path) -- never blocks on the build."""
+    if not ready():
+        return None
+    ptr, keep = _as_ptr(data)
+    out = int(_lib.zoo_crc32c(ptr, len(data)))
+    del keep
+    return out
+
+
+class CorruptRecordError(ValueError):
+    pass
+
+
+def scan_tfrecords(buf, verify: bool = False) -> List[Tuple[int, int]]:
+    """All (payload_offset, payload_length) frames in a TFRecord
+    buffer. ``verify=True`` checks both masked CRCs per record and
+    raises CorruptRecordError naming the first bad record."""
+    if not available():
+        return _py_scan(buf, verify)
+    n = len(buf)
+    ptr, keep = _as_ptr(buf)
+    try:
+        # worst case: empty payloads -> every 16 bytes is a record
+        cap = max(n // 16, 1)
+        offs = (ctypes.c_uint64 * cap)()
+        lens = (ctypes.c_uint64 * cap)()
+        got = _lib.zoo_scan_tfrecords(ptr, n, offs, lens, cap,
+                                      1 if verify else 0)
+    finally:
+        was_view = not isinstance(buf, (bytes, bytearray))
+        del ptr, keep
+        if was_view:
+            # ctypes' buffer export is released at GC, not refcount
+            # drop; collect now so the caller's mmap can close
+            import gc
+
+            gc.collect()
+    if got < 0:
+        raise CorruptRecordError(f"record {-got - 1} failed crc check")
+    return [(int(offs[i]), int(lens[i])) for i in range(got)]
+
+
+def _py_scan(buf, verify: bool) -> List[Tuple[int, int]]:
+    import struct
+
+    from analytics_zoo_tpu.utils.summary import _masked_crc
+
+    out: List[Tuple[int, int]] = []
+    pos = 0
+    n = len(buf)
+    while n - pos >= 16:
+        (length,) = struct.unpack_from("<Q", buf, pos)
+        if length > n - pos - 16:
+            break
+        if verify:
+            (lc,) = struct.unpack_from("<I", buf, pos + 8)
+            (pc,) = struct.unpack_from("<I", buf, pos + 12 + length)
+            if (_masked_crc(bytes(buf[pos:pos + 8])) != lc or
+                    _masked_crc(bytes(buf[pos + 12:pos + 12 + length]))
+                    != pc):
+                raise CorruptRecordError(
+                    f"record {len(out)} failed crc check")
+        out.append((pos + 12, length))
+        pos += 12 + length + 4
+    return out

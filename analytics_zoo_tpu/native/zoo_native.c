@@ -1,0 +1,104 @@
+/* Native host-side kernels for the data/observability hot paths.
+ *
+ * The reference keeps its native code in external zoo-core artifacts
+ * (MKL kernels, PMEM allocator -- SURVEY.md section 2.4); the TPU
+ * rebuild's device math lives in XLA/Pallas, so the remaining native
+ * surface is host-side IO: TFRecord frame scanning for the data loader
+ * (ref: TFRecord framing used by tfpark datasets) and the masked
+ * crc32c that both TFRecord and the TensorBoard event writer frame
+ * records with (ref: zoo/.../tensorboard/EventWriter.scala:32-80).
+ *
+ * Built at first use via `cc -O3 -shared -fPIC` (see native/__init__.py)
+ * and bound with ctypes; everything has a pure-Python fallback.
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+
+/* ----------------------------- crc32c (Castagnoli), slicing-by-8 ---- */
+
+static uint32_t crc_table[8][256];
+static int table_ready = 0;
+
+static void init_table(void) {
+    uint32_t poly = 0x82F63B78u; /* reflected 0x1EDC6F41 */
+    for (int i = 0; i < 256; i++) {
+        uint32_t crc = (uint32_t)i;
+        for (int j = 0; j < 8; j++)
+            crc = (crc & 1) ? (crc >> 1) ^ poly : crc >> 1;
+        crc_table[0][i] = crc;
+    }
+    for (int i = 0; i < 256; i++) {
+        uint32_t crc = crc_table[0][i];
+        for (int s = 1; s < 8; s++) {
+            crc = crc_table[0][crc & 0xFF] ^ (crc >> 8);
+            crc_table[s][i] = crc;
+        }
+    }
+    table_ready = 1;
+}
+
+uint32_t zoo_crc32c(const uint8_t *buf, size_t len) {
+    if (!table_ready) init_table();
+    uint32_t crc = 0xFFFFFFFFu;
+    while (len >= 8) {
+        crc ^= (uint32_t)buf[0] | ((uint32_t)buf[1] << 8) |
+               ((uint32_t)buf[2] << 16) | ((uint32_t)buf[3] << 24);
+        uint32_t hi = (uint32_t)buf[4] | ((uint32_t)buf[5] << 8) |
+                      ((uint32_t)buf[6] << 16) | ((uint32_t)buf[7] << 24);
+        crc = crc_table[7][crc & 0xFF] ^ crc_table[6][(crc >> 8) & 0xFF] ^
+              crc_table[5][(crc >> 16) & 0xFF] ^
+              crc_table[4][(crc >> 24) & 0xFF] ^
+              crc_table[3][hi & 0xFF] ^ crc_table[2][(hi >> 8) & 0xFF] ^
+              crc_table[1][(hi >> 16) & 0xFF] ^
+              crc_table[0][(hi >> 24) & 0xFF];
+        buf += 8;
+        len -= 8;
+    }
+    while (len--) crc = crc_table[0][(crc ^ *buf++) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
+static uint32_t masked(uint32_t crc) {
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8u);
+}
+
+/* ------------------------------------- TFRecord frame scanning ------ */
+/* Record: u64le length | u32le masked_crc(length) | payload
+ *         | u32le masked_crc(payload)
+ * Fills offsets/lengths (payload positions) up to max_records.
+ * Returns the number of records found; negative on corruption when
+ * verify != 0 (-(index+1) of the bad record). */
+
+int64_t zoo_scan_tfrecords(const uint8_t *buf, uint64_t n,
+                           uint64_t *offsets, uint64_t *lengths,
+                           uint64_t max_records, int verify) {
+    uint64_t pos = 0, count = 0;
+    while (n - pos >= 16 && count < max_records) {
+        uint64_t len = 0;
+        for (int i = 0; i < 8; i++) len |= (uint64_t)buf[pos + i] << (8 * i);
+        /* subtraction form: an addition like pos+12+len+4 could wrap
+         * modulo 2^64 for a corrupt length and pass the bound check */
+        if (len > n - pos - 16) break; /* truncated or corrupt tail */
+        if (verify) {
+            uint32_t lc = (uint32_t)buf[pos + 8] |
+                          ((uint32_t)buf[pos + 9] << 8) |
+                          ((uint32_t)buf[pos + 10] << 16) |
+                          ((uint32_t)buf[pos + 11] << 24);
+            if (masked(zoo_crc32c(buf + pos, 8)) != lc)
+                return -((int64_t)count + 1);
+            const uint8_t *payload = buf + pos + 12;
+            uint32_t pc = (uint32_t)payload[len] |
+                          ((uint32_t)payload[len + 1] << 8) |
+                          ((uint32_t)payload[len + 2] << 16) |
+                          ((uint32_t)payload[len + 3] << 24);
+            if (masked(zoo_crc32c(payload, len)) != pc)
+                return -((int64_t)count + 1);
+        }
+        offsets[count] = pos + 12;
+        lengths[count] = len;
+        count++;
+        pos += 12 + len + 4;
+    }
+    return (int64_t)count;
+}
