@@ -357,3 +357,35 @@ class TestTrainingProfiler:
                 profile=True)
         summary = est.last_profile.summary()
         assert summary["train_step"]["count"] == 2  # one per epoch
+
+
+class TestAUCLogits:
+    def test_logit_scores_not_degenerate(self):
+        m = M.AUC()
+        s = m.empty()
+        # perfectly separating LOGITS (outside [0,1])
+        preds = jnp.asarray([-5.0, -2.0, 2.0, 5.0])
+        labels = jnp.asarray([0, 0, 1, 1])
+        s = m.update(s, preds, labels)
+        assert float(m.result(s)) == pytest.approx(1.0, abs=0.02)
+
+    def test_streaming_batches_share_one_scale(self):
+        # batch 1 has out-of-range logits, batch 2 happens to land in
+        # [0,1]; both must be squashed identically or the merged
+        # histograms mix scales
+        m = M.AUC()
+        s = m.empty()
+        s = m.update(s, jnp.asarray([-4.0, 4.0]), jnp.asarray([0, 1]))
+        s = m.update(s, jnp.asarray([0.1, 0.9]), jnp.asarray([0, 1]))
+        assert float(m.result(s)) == pytest.approx(1.0, abs=0.02)
+
+    def test_from_logits_true_and_false(self):
+        preds = jnp.asarray([-3.0, 3.0])
+        labels = jnp.asarray([0, 1])
+        m = M.AUC(from_logits=True)
+        s = m.update(m.empty(), preds, labels)
+        assert float(m.result(s)) == pytest.approx(1.0, abs=0.02)
+        # probabilities pass through unchanged with from_logits=False
+        m2 = M.AUC(from_logits=False)
+        s2 = m2.update(m2.empty(), jnp.asarray([0.1, 0.9]), labels)
+        assert float(m2.result(s2)) == pytest.approx(1.0, abs=0.02)
