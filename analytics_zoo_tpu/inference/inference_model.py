@@ -49,6 +49,7 @@ class InferenceModel:
         self._compiled: Dict[Any, Callable] = {}
         self._lock = threading.Lock()
         self._quantized = False
+        self.example_input = None  # set by load_zoo for warm_up
 
     # ------------------------------------------------------------ loads --
     def load_zoo(self, path: str) -> "InferenceModel":
@@ -62,6 +63,10 @@ class InferenceModel:
             lambda variables, x: adapter.apply(variables, x,
                                                training=False)[0])
         self.variables = est.variables
+        try:  # lets deployments warm_up without knowing the model class
+            self.example_input = model._example_input()
+        except Exception:
+            self.example_input = None
         return self
 
     def load_flax(self, module, variables=None,

@@ -157,18 +157,19 @@ class AUC(Metric):
     binned estimator TF/Keras uses (ref: keras/metrics AUC).
 
     The thresholds span [0, 1], so raw logits need squashing.
-    ``from_logits``: True (and the default "auto") always applies
-    sigmoid -- ROC is invariant under monotone maps, so probabilities
-    passed through sigmoid keep their AUC (the binned estimator just
-    spends its thresholds on a narrower band), while raw logits would
-    silently degenerate (round-1 review finding). The transform is the
-    SAME for every batch, keeping the streaming histograms on one score
-    scale. Pass False for pre-squashed scores at full bin resolution.
+    ``from_logits=True`` (the default) always applies sigmoid -- ROC is
+    invariant under monotone maps, so probabilities passed through
+    sigmoid keep their AUC (the binned estimator just spends its
+    thresholds on a narrower band), while raw logits would silently
+    degenerate (round-1 review finding). The transform is the SAME for
+    every batch, keeping the streaming histograms on one score scale.
+    Pass False for pre-squashed scores at full bin resolution.
     """
 
     name = "auc"
 
-    def __init__(self, num_thresholds: int = 200, from_logits="auto"):
+    def __init__(self, num_thresholds: int = 200,
+                 from_logits: bool = True):
         self.num_thresholds = num_thresholds
         self.from_logits = from_logits
 
@@ -178,7 +179,7 @@ class AUC(Metric):
 
     def update(self, state, preds, labels, weights=None):
         scores = jnp.asarray(preds).reshape(-1)
-        if self.from_logits:  # True or "auto": batch-independent squash
+        if self.from_logits:  # batch-independent squash
             scores = jax.nn.sigmoid(scores)
         y = jnp.asarray(labels).reshape(-1).astype(jnp.float32)
         w = (jnp.ones_like(scores) if weights is None

@@ -17,6 +17,11 @@ from analytics_zoo_tpu.serving.queues import (  # noqa: F401
 )
 from analytics_zoo_tpu.serving.batcher import MicroBatcher  # noqa: F401
 from analytics_zoo_tpu.serving.worker import ServingWorker  # noqa: F401
+from analytics_zoo_tpu.serving.launcher import (  # noqa: F401
+    ServingApp,
+    launch,
+    launch_from_yaml,
+)
 from analytics_zoo_tpu.serving.timer import Timer  # noqa: F401
 from analytics_zoo_tpu.serving.http_frontend import (  # noqa: F401
     HttpFrontend,

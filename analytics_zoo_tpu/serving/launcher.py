@@ -1,0 +1,162 @@
+"""Config-driven serving launcher.
+
+The analog of the reference's YAML-configured serving deployment
+(ref: scripts/cluster-serving/config.yaml parsed by
+zoo/.../serving/utils/ClusterServingHelper.scala; job lifecycle in
+ClusterServingManager). One YAML describes the model, the queue, the
+batching params and the HTTP frontend; ``launch(config)`` (or
+``python -m analytics_zoo_tpu.serving.launcher -c config.yaml``)
+assembles InferenceModel + ServingWorker + HttpFrontend and runs until
+stopped.
+
+Config schema (defaults in parentheses)::
+
+    model:
+      path: /path/to/saved_zoo_model     # ZooModel.save_model dir
+      encrypted: false                   # load_encrypted_zoo
+      secret: null                       #   its AES secret
+    data:
+      queue: memory | dir (memory)
+      path: null                         # dir-queue directory
+      maxlen: 10000
+    params:
+      batch_size: 8                      # micro-batch cap (core_number)
+      timeout_ms: 5.0
+      top_n: null                        # classes/scores of top-N
+      warm_batch_sizes: [1, 8]           # pre-compiled buckets (uses the
+                                         # model's example input)
+    http:
+      enabled: true
+      host: 127.0.0.1
+      port: 0                            # 0 = pick a free port
+
+With ``http.enabled`` the frontend OWNS the result stream (its router
+consumes every worker result, HttpFrontend's contract) -- direct queue
+clients should deploy with ``http.enabled: false`` and read
+``app.output_queue`` themselves.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+from typing import Any, Dict, Optional
+
+from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
+from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.worker import ServingWorker
+
+logger = get_logger(__name__)
+
+
+class ServingApp:
+    """A running serving deployment: model + worker + optional HTTP."""
+
+    def __init__(self, model: InferenceModel, worker: ServingWorker,
+                 input_queue: InputQueue, output_queue: OutputQueue,
+                 frontend: Optional[HttpFrontend]):
+        self.model = model
+        self.worker = worker
+        self.input_queue = input_queue
+        self.output_queue = output_queue
+        self.frontend = frontend
+
+    @property
+    def address(self) -> Optional[str]:
+        return self.frontend.address if self.frontend else None
+
+    def stop(self) -> None:
+        if self.frontend is not None:
+            self.frontend.stop()
+        self.worker.stop()
+        logger.info("serving stopped")
+
+
+def _load_model(cfg: Dict[str, Any]) -> InferenceModel:
+    mcfg = cfg.get("model") or {}
+    path = mcfg.get("path")
+    if not path:
+        raise ValueError("config needs model.path")
+    model = InferenceModel()
+    if mcfg.get("encrypted"):
+        secret = mcfg.get("secret")
+        if not secret:
+            raise ValueError("model.encrypted needs model.secret")
+        model.load_encrypted_zoo(path, secret)
+    else:
+        model.load_zoo(path)
+    return model
+
+
+def launch(config: Dict[str, Any]) -> ServingApp:
+    """Assemble and start a deployment from a parsed config dict."""
+    model = _load_model(config)
+    data = config.get("data") or {}
+    params = config.get("params") or {}
+    http = config.get("http") or {}
+
+    if data.get("queue") == "dir" and not data.get("path"):
+        raise ValueError('data.queue "dir" needs data.path')
+    # backend=None lets the queues module infer dir-backing from path
+    in_q = InputQueue(backend=data.get("queue"),
+                      path=data.get("path"),
+                      maxlen=data.get("maxlen", 10000))
+    out_q = OutputQueue(backend=data.get("queue"),
+                        path=(data.get("path") + ".out"
+                              if data.get("path") else None))
+    warm = params.get("warm_batch_sizes", (1, 8))
+    if warm:
+        warm_example = params.get("warm_example", model.example_input)
+        if warm_example is not None:
+            model.warm_up(warm_example, batch_sizes=tuple(warm))
+        else:
+            logger.warning(
+                "warm_batch_sizes set but no example input is "
+                "available; skipping warm-up")
+    worker = ServingWorker(
+        model, in_q, out_q, batch_size=params.get("batch_size", 8),
+        timeout_ms=params.get("timeout_ms", 5.0),
+        top_n=params.get("top_n")).start()
+    frontend = None
+    try:
+        if http.get("enabled", True):
+            frontend = HttpFrontend(
+                in_q, out_q, host=http.get("host", "127.0.0.1"),
+                port=http.get("port", 0), worker=worker).start()
+            logger.info("serving ready at %s", frontend.address)
+    except Exception:
+        worker.stop()  # no ServingApp handle escapes; don't leak it
+        raise
+    return ServingApp(model, worker, in_q, out_q, frontend)
+
+
+def launch_from_yaml(path: str) -> ServingApp:
+    import yaml
+
+    with open(path) as f:
+        return launch(yaml.safe_load(f) or {})
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="analytics_zoo_tpu serving launcher")
+    ap.add_argument("-c", "--config", required=True,
+                    help="path to the serving YAML config")
+    args = ap.parse_args(argv)
+    app = launch_from_yaml(args.config)
+    stop = threading.Event()
+
+    def handler(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    stop.wait()
+    app.stop()
+
+
+if __name__ == "__main__":
+    main()

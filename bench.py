@@ -33,7 +33,7 @@ sys.path.insert(0, REPO)
 # MovieLens-1M scale (ref: ml-1m 6040 users / 3706 movies, 5-star ratings)
 USERS, ITEMS, CLASSES = 6040, 3706, 5
 NCF_BATCH = 65536
-NCF_EPOCHS = 3  # first epoch absorbs compile; later epochs measured
+NCF_EPOCHS = 5  # first epoch absorbs compile; later epochs measured
 
 # BERT-base SQuAD fine-tune config (ref: bert_squad.py / BERT-base)
 BERT_VOCAB, BERT_SEQ = 30522, 384

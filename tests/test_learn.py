@@ -314,9 +314,9 @@ class TestDeviceCachedFit:
         x, y = self.make_data()
         est = self.make_estimator()
         est.fit((x, y), batch_size=64, epochs=1, device_cache=True)
-        fn_first = est._epoch_fns[(64, 8)]
+        fn_first = est._epoch_fns[(64, 8, 512)]
         est.fit((x, y), batch_size=64, epochs=2, device_cache=True)
-        assert est._epoch_fns[(64, 8)] is fn_first
+        assert est._epoch_fns[(64, 8, 512)] is fn_first
 
 
 class TestTrainingProfiler:

@@ -217,3 +217,79 @@ def test_http_bad_request(http_stack):
         with pytest.raises(urllib.error.HTTPError) as exc_info:
             _post(http_stack.address + "/predict", bad)
         assert exc_info.value.code == 400, bad
+
+
+class TestLauncher:
+    """Config-driven deployment (ref: config.yaml +
+    ClusterServingHelper)."""
+
+    def make_model_dir(self, tmp_path):
+        from analytics_zoo_tpu.models import TextClassifier
+
+        rng = np.random.RandomState(0)
+        x = rng.randint(1, 50, (64, 6)).astype(np.int32)
+        y = (x[:, 0] > 25).astype(np.int32)
+        m = TextClassifier(class_num=2, vocab=50, embed_dim=8,
+                           sequence_length=6)
+        m.fit((x, y), batch_size=32, epochs=1)
+        path = str(tmp_path / "model")
+        m.save_model(path)
+        return path
+
+    def test_yaml_launch_end_to_end(self, tmp_path):
+        import urllib.request
+        import yaml
+
+        from analytics_zoo_tpu.serving.launcher import launch_from_yaml
+
+        path = self.make_model_dir(tmp_path)
+        # queue-client deployment: http off, results read directly
+        cfg = {
+            "model": {"path": path},
+            "data": {"queue": "memory", "maxlen": 64},
+            "params": {"batch_size": 4, "timeout_ms": 5},
+            "http": {"enabled": False},
+        }
+        cfg_path = tmp_path / "config.yaml"
+        cfg_path.write_text(yaml.safe_dump(cfg))
+        app = launch_from_yaml(str(cfg_path))
+        try:
+            app.input_queue.enqueue(
+                "r1", input=np.ones(6, np.int32))
+            uri, tensors = app.output_queue.dequeue(timeout=10)
+            assert uri == "r1" and "output" in tensors
+            assert app.address is None
+        finally:
+            app.stop()
+
+        # http deployment: the frontend owns the result stream
+        cfg["http"] = {"enabled": True}
+        cfg["params"]["warm_batch_sizes"] = [1, 4]
+        cfg_path.write_text(yaml.safe_dump(cfg))
+        app = launch_from_yaml(str(cfg_path))
+        try:
+            assert len(app.model._compiled) >= 2  # warmed buckets
+            payload = json.dumps(
+                {"inputs": {"input": [1, 2, 3, 4, 5, 6]}}).encode()
+            req = urllib.request.Request(
+                app.address + "/predict", data=payload,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=20) as resp:
+                body = json.loads(resp.read())
+            assert "predictions" in body
+        finally:
+            app.stop()
+
+    def test_dir_queue_requires_path(self, tmp_path):
+        from analytics_zoo_tpu.serving.launcher import launch
+
+        path = self.make_model_dir(tmp_path)
+        with pytest.raises(ValueError, match="data.path"):
+            launch({"model": {"path": path},
+                    "data": {"queue": "dir"}})
+
+    def test_missing_model_path_raises(self):
+        from analytics_zoo_tpu.serving.launcher import launch
+
+        with pytest.raises(ValueError, match="model.path"):
+            launch({"model": {}})
