@@ -164,7 +164,11 @@ class InferenceModel:
         batch size compiles its power-of-two bucket."""
         if self._apply_fn is None:
             raise RuntimeError("no model loaded")
-        example = jax.tree_util.tree_map(np.asarray, example_input)
+        # lists count as leaves so YAML-sourced examples ({input:
+        # [[1,2,3]]}) become proper arrays, not 0-d scalar trees
+        example = jax.tree_util.tree_map(
+            np.asarray, example_input,
+            is_leaf=lambda v: isinstance(v, list))
         done = set()
         for bs in batch_sizes:
             bucket = _bucket(bs)
@@ -188,7 +192,18 @@ class InferenceModel:
         queue)."""
         if self._apply_fn is None:
             raise RuntimeError("no model loaded")
-        x = jax.tree_util.tree_map(np.asarray, x)
+        # canonicalize 64-bit host inputs (JSON ints/floats) to the
+        # 32-bit dtypes jax runs anyway -- otherwise the shape-bucket
+        # key differs from warmed buckets and recompiles pointlessly
+        def canon(a):
+            a = np.asarray(a)
+            if a.dtype == np.float64:
+                return a.astype(np.float32)
+            if a.dtype == np.int64:
+                return a.astype(np.int32)
+            return a
+
+        x = jax.tree_util.tree_map(canon, x)
         leaves = jax.tree_util.tree_leaves(x)
         n = leaves[0].shape[0]
         bucket = _bucket(n)

@@ -107,7 +107,17 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     out_q = OutputQueue(backend=data.get("queue"),
                         path=(data.get("path") + ".out"
                               if data.get("path") else None))
-    warm = params.get("warm_batch_sizes", (1, 8))
+    from analytics_zoo_tpu.inference.inference_model import _bucket
+
+    # default: every power-of-two bucket the micro-batcher can emit, so
+    # no live request ever pays an XLA compile
+    batch_size = params.get("batch_size", 8)
+    default_warm = []
+    b = 1
+    while b <= _bucket(batch_size):
+        default_warm.append(b)
+        b *= 2
+    warm = params.get("warm_batch_sizes", default_warm)
     if warm:
         warm_example = params.get("warm_example", model.example_input)
         if warm_example is not None:

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Scaling-efficiency harness (BASELINE north-star #3: 8->64-chip
+scaling efficiency, target >90% on v5e-64).
+
+Measures WEAK scaling of the NCF SPMD train step across data-parallel
+mesh sizes: per-device batch held constant, throughput per device
+compared against the single-device run. On real multi-chip hardware
+this reports the ICI/DCN allreduce efficiency; on one host it validates
+the harness over virtual devices (pass --virtual N, which forces the
+CPU backend -- virtual-device numbers exercise the code path, not the
+interconnect).
+
+Prints one JSON line:
+  {"metric": "scaling_efficiency", "value": <eff at max size>,
+   "unit": "fraction", "extras": {"points": {...}}}
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+def measure(mesh_devices, per_device_batch: int, steps: int = 20):
+    import jax
+    import numpy as np
+
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF
+    from analytics_zoo_tpu.parallel import create_mesh
+
+    n_dev = len(mesh_devices)
+    mesh = create_mesh({"data": n_dev}, devices=mesh_devices)
+    model = NeuralCF(6040, 3706, class_num=5)
+    est = Estimator(model.module, loss=model.default_loss,
+                    optimizer="adam", mesh=mesh)
+    batch = per_device_batch * n_dev
+    rng = np.random.RandomState(0)
+    x = np.stack([rng.randint(1, 6041, batch),
+                  rng.randint(1, 3707, batch)], 1).astype(np.int32)
+    y = rng.randint(1, 6, batch).astype(np.int32)
+    est._ensure_built(x[:8])
+    step = est._build_train_step()
+    from analytics_zoo_tpu.parallel.sharding import shard_batch
+
+    xb = shard_batch(x, mesh)
+    yb = shard_batch(y, mesh)
+    import jax.numpy as jnp
+
+    loss_sum = jnp.zeros((), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    # warm-up (compile)
+    v, o, loss_sum, _ = step(est.variables, est.opt_state, loss_sum,
+                             xb, yb, key)
+    jax.block_until_ready(loss_sum)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        v, o, loss_sum, _ = step(v, o, loss_sum, xb, yb,
+                                 jax.random.fold_in(key, i))
+    jax.block_until_ready(loss_sum)
+    dt = time.perf_counter() - t0
+    return steps * batch / dt / n_dev  # samples/sec/device
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--virtual", type=int, default=None,
+                    help="force N virtual CPU devices (harness check)")
+    ap.add_argument("--per-device-batch", type=int, default=8192)
+    args = ap.parse_args()
+    if args.virtual:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={args.virtual}"
+        ).strip()
+    import jax
+
+    if args.virtual:
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    sizes = [s for s in (1, 2, 4, 8, 16, 32, 64) if s <= len(devices)]
+    points = {}
+    for s in sizes:
+        points[s] = measure(devices[:s], args.per_device_batch)
+    base = points[sizes[0]]
+    eff = {s: round(v / base, 4) for s, v in points.items()}
+    print(json.dumps({
+        "metric": "scaling_efficiency",
+        "value": eff[sizes[-1]],
+        "unit": "fraction_of_linear",
+        "extras": {
+            "per_device_batch": args.per_device_batch,
+            "samples_per_sec_per_device": {
+                str(s): round(v, 1) for s, v in points.items()},
+            "efficiency": {str(s): e for s, e in eff.items()},
+            "note": ("virtual CPU devices (harness validation), not "
+                     "interconnect perf" if args.virtual else
+                     "real devices"),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Smoke the scaling-efficiency harness (north-star #3 tooling)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_scaling_harness_outputs_json():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_scaling.py"),
+         "--virtual", "4", "--per-device-batch", "256"],
+        capture_output=True, text=True, timeout=540, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "scaling_efficiency"
+    assert set(out["extras"]["efficiency"]) == {"1", "2", "4"}
+    assert out["extras"]["efficiency"]["1"] == 1.0

@@ -151,3 +151,16 @@ class TestQuantizeUnit:
         err = np.max(np.abs(np.asarray(dq["w"]) - params["w"]))
         assert err <= np.abs(params["w"]).max() / 127 + 1e-6
         np.testing.assert_allclose(np.asarray(dq["b"]), params["b"])
+
+
+class TestWarmUpYaml:
+    def test_warm_up_accepts_yaml_style_lists(self):
+        import jax
+
+        net = SmallNet()
+        x = np.zeros((1, 6), np.float32)
+        variables = net.init(jax.random.PRNGKey(0), x)
+        inf = InferenceModel().load_flax(net, variables=variables)
+        # YAML-expressible nested lists must warm correctly
+        inf.warm_up([[0.0] * 6], batch_sizes=(1, 4))
+        assert len(inf._compiled) == 2
