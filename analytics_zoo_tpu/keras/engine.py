@@ -242,6 +242,12 @@ class KerasNet:
             raise RuntimeError("set_tensorboard was not called")
         return read_events(self._log_dir).get(tag, [])
 
+    def get_validation_summary(self, metric: str = "loss"):
+        """Read back validation scalars (ref: Topology.scala
+        getValidationSummary); ``metric`` is the metric name, e.g.
+        "accuracy"."""
+        return self.get_train_summary(tag=f"validation/{metric}")
+
 
 class Sequential(KerasNet):
     """(ref: Topology.scala:631+ Sequential, keras Sequential)."""

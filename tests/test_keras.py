@@ -239,3 +239,24 @@ class TestGraphModel:
         x = np.random.randn(8, 4).astype(np.float32)
         preds = model.predict(x, batch_size=8)
         assert preds.shape == (8, 4)
+
+
+class TestTensorBoardReadback:
+    def test_train_and_validation_summaries(self, tmp_path):
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras.layers import Dense
+
+        x = np.random.RandomState(0).randn(128, 4).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        m = Sequential([Dense(8, activation="relu"), Dense(2)])
+        m.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.set_tensorboard(str(tmp_path), "app")
+        m.fit(x, y, batch_size=32, nb_epoch=2, validation_data=(x, y))
+        train = m.get_train_summary("train/loss")
+        assert len(train) >= 1
+        val = m.get_validation_summary("accuracy")
+        assert len(val) == 2  # one per epoch (EveryEpoch trigger)
+        steps = [s for s, _ in val]
+        assert steps == sorted(steps)
