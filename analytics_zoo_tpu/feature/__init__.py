@@ -16,6 +16,8 @@ from analytics_zoo_tpu.feature.image import (
     ImageChannelOrder, ImageHFlip, ImageHue, ImageMatToTensor,
     ImagePixelNormalizer, ImageRandomCrop, ImageRandomPreprocessing,
     ImageResize, ImageSaturation, ImageSet, ImageSetToSample)
+from analytics_zoo_tpu.feature.image3d import (
+    AffineTransform3D, CenterCrop3D, Crop3D, RandomCrop3D, Rotate3D)
 
 __all__ = [
     "TextFeature", "TextSet", "Tokenizer", "Normalizer", "WordIndexer",
@@ -24,4 +26,6 @@ __all__ = [
     "ImageHFlip", "ImageBrightness", "ImageHue", "ImageSaturation",
     "ImageChannelNormalize", "ImagePixelNormalizer", "ImageChannelOrder",
     "ImageMatToTensor", "ImageSetToSample", "ImageRandomPreprocessing",
+    "Crop3D", "CenterCrop3D", "RandomCrop3D", "Rotate3D",
+    "AffineTransform3D",
 ]

@@ -1,0 +1,153 @@
+"""3-D image preprocessing ops (volumetric / medical imaging).
+
+The analog of the reference's image3d family
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/feature/image3d/ --
+Cropper.scala (Crop3D / RandomCrop3D / CenterCrop3D), Rotation.scala
+(Rotate3D around an axis by trilinear resampling), Affine.scala
+(AffineTransform3D matrix warp)). Volumes travel as float32 [D, H, W]
+or [D, H, W, C] arrays; ops compose through the same ``ImageSet`` /
+``ImageProcessing`` chain as the 2-D library.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.image import ImageProcessing
+
+
+def _spatial(img: np.ndarray):
+    """(depth, height, width) regardless of a trailing channel dim."""
+    return img.shape[:3]
+
+
+class Crop3D(ImageProcessing):
+    """Crop a [depth, height, width] box at ``start`` (z, y, x)
+    (ref: image3d/Cropper.scala Crop3D). The box must fit -- a silent
+    short slice would only crash later at batch-stacking time."""
+
+    def __init__(self, start: Sequence[int], patch: Sequence[int]):
+        self.start = tuple(int(v) for v in start)
+        self.patch = tuple(int(v) for v in patch)
+        if any(v < 0 for v in self.start) or \
+                any(v <= 0 for v in self.patch):
+            raise ValueError(f"invalid crop start={self.start} "
+                             f"patch={self.patch}")
+
+    def apply_image(self, img):
+        dims = _spatial(img)
+        for i in range(3):
+            if self.start[i] + self.patch[i] > dims[i]:
+                raise ValueError(
+                    f"crop box start={self.start} patch={self.patch} "
+                    f"does not fit volume {dims}")
+        z, y, x = self.start
+        d, h, w = self.patch
+        return img[z:z + d, y:y + h, x:x + w]
+
+
+class CenterCrop3D(ImageProcessing):
+    """(ref: Cropper.scala CenterCrop3D)."""
+
+    def __init__(self, patch: Sequence[int]):
+        self.patch = tuple(int(v) for v in patch)
+
+    def apply_image(self, img):
+        dims = _spatial(img)
+        start = [max(0, (dims[i] - self.patch[i]) // 2) for i in range(3)]
+        return Crop3D(start, self.patch).apply_image(img)
+
+
+class RandomCrop3D(ImageProcessing):
+    """(ref: Cropper.scala RandomCrop3D)."""
+
+    def __init__(self, patch: Sequence[int], seed: Optional[int] = None):
+        self.patch = tuple(int(v) for v in patch)
+        self._rng = np.random.RandomState(seed)
+
+    def apply_image(self, img):
+        dims = _spatial(img)
+        start = [self._rng.randint(0, max(1, dims[i] - self.patch[i] + 1))
+                 for i in range(3)]
+        return Crop3D(start, self.patch).apply_image(img)
+
+
+def _trilinear_sample(img: np.ndarray, coords: np.ndarray) -> np.ndarray:
+    """Sample ``img`` [D, H, W] or [D, H, W, C] at fractional coords
+    [3, N] with trilinear interpolation (indices/weights computed once;
+    gathers broadcast over a trailing channel axis); out-of-bounds
+    reads clamp to the edge. Returns [N] or [N, C]."""
+    d, h, w = img.shape[:3]
+    z, y, x = coords
+    z0 = np.clip(np.floor(z).astype(np.int64), 0, d - 1)
+    y0 = np.clip(np.floor(y).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(x).astype(np.int64), 0, w - 1)
+    z1 = np.minimum(z0 + 1, d - 1)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    expand = img.ndim == 4
+
+    def frac(v, v0):
+        f = np.clip(v - v0, 0.0, 1.0)
+        return f[:, None] if expand else f
+
+    fz, fy, fx = frac(z, z0), frac(y, y0), frac(x, x0)
+
+    def at(zi, yi, xi):
+        return img[zi, yi, xi]
+
+    c000, c001 = at(z0, y0, x0), at(z0, y0, x1)
+    c010, c011 = at(z0, y1, x0), at(z0, y1, x1)
+    c100, c101 = at(z1, y0, x0), at(z1, y0, x1)
+    c110, c111 = at(z1, y1, x0), at(z1, y1, x1)
+    c00 = c000 * (1 - fx) + c001 * fx
+    c01 = c010 * (1 - fx) + c011 * fx
+    c10 = c100 * (1 - fx) + c101 * fx
+    c11 = c110 * (1 - fx) + c111 * fx
+    c0 = c00 * (1 - fy) + c01 * fy
+    c1 = c10 * (1 - fy) + c11 * fy
+    return c0 * (1 - fz) + c1 * fz
+
+
+class AffineTransform3D(ImageProcessing):
+    """Warp a volume by a 3x3 matrix + translation about its center
+    (ref: image3d/Affine.scala AffineTransform3D): output voxel p maps
+    to input ``mat @ (p - c) + c + translation``."""
+
+    def __init__(self, mat: np.ndarray,
+                 translation: Optional[Sequence[float]] = None):
+        self.mat = np.asarray(mat, np.float64).reshape(3, 3)
+        self.translation = np.asarray(translation if translation
+                                      is not None else (0, 0, 0),
+                                      np.float64)
+
+    def apply_image(self, img):
+        img = np.asarray(img, np.float32)
+        dims = _spatial(img)
+        grid = np.stack(np.meshgrid(
+            np.arange(dims[0]), np.arange(dims[1]), np.arange(dims[2]),
+            indexing="ij"), 0).reshape(3, -1).astype(np.float64)
+        center = (np.asarray(dims, np.float64) - 1)[:, None] / 2
+        src = (self.mat @ (grid - center) + center
+               + self.translation[:, None])
+        out = _trilinear_sample(img, src)
+        return out.reshape(img.shape).astype(np.float32)
+
+
+class Rotate3D(AffineTransform3D):
+    """Rotate about one axis ('z' = depth, 'y', or 'x') by ``angle``
+    radians (ref: image3d/Rotation.scala)."""
+
+    def __init__(self, angle: float, axis: str = "z"):
+        c, s = float(np.cos(angle)), float(np.sin(angle))
+        if axis == "z":        # rotate in the (h, w) plane
+            mat = [[1, 0, 0], [0, c, -s], [0, s, c]]
+        elif axis == "y":      # (d, w) plane
+            mat = [[c, 0, -s], [0, 1, 0], [s, 0, c]]
+        elif axis == "x":      # (d, h) plane
+            mat = [[c, -s, 0], [s, c, 0], [0, 0, 1]]
+        else:
+            raise ValueError("axis must be one of z/y/x")
+        super().__init__(np.asarray(mat))

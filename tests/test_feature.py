@@ -243,3 +243,59 @@ class TestImageOps:
         iset = ImageSet.read(str(tmp_path))
         assert len(iset) == 4
         assert sorted(set(iset.get_labels())) == [0, 1]
+
+
+class TestImage3D:
+    def vol(self, d=12, h=10, w=8, seed=0):
+        return np.random.RandomState(seed).uniform(
+            0, 1, (d, h, w)).astype(np.float32)
+
+    def test_crop3d_variants(self):
+        from analytics_zoo_tpu.feature import (
+            CenterCrop3D, Crop3D, RandomCrop3D)
+
+        v = self.vol()
+        out = Crop3D((2, 1, 0), (4, 4, 4)).apply_image(v)
+        np.testing.assert_array_equal(out, v[2:6, 1:5, 0:4])
+        assert CenterCrop3D((6, 6, 6)).apply_image(v).shape == (6, 6, 6)
+        assert RandomCrop3D((4, 4, 4), seed=0).apply_image(v).shape == \
+            (4, 4, 4)
+
+    def test_rotate3d_identity_and_quarter_turn(self):
+        from analytics_zoo_tpu.feature import Rotate3D
+
+        v = self.vol(6, 8, 8)
+        ident = Rotate3D(0.0, axis="z").apply_image(v)
+        np.testing.assert_allclose(ident, v, atol=1e-5)
+        # 90-degree z-rotation of an (h, w)-square volume matches the
+        # exact grid rotation
+        quarter = Rotate3D(np.pi / 2, axis="z").apply_image(v)
+        expect = np.stack([np.rot90(v[i], k=-1) for i in range(6)])
+        np.testing.assert_allclose(quarter, expect, atol=1e-4)
+
+    def test_affine_translation(self):
+        from analytics_zoo_tpu.feature import AffineTransform3D
+
+        v = self.vol(4, 4, 4)
+        out = AffineTransform3D(np.eye(3),
+                                translation=(1, 0, 0)).apply_image(v)
+        # output voxel z reads input voxel z+1 (edge clamps)
+        np.testing.assert_allclose(out[:3], v[1:], atol=1e-5)
+
+    def test_channelled_volume(self):
+        from analytics_zoo_tpu.feature import Rotate3D
+
+        v = np.random.RandomState(1).uniform(
+            0, 1, (4, 6, 6, 2)).astype(np.float32)
+        out = Rotate3D(0.0).apply_image(v)
+        assert out.shape == v.shape
+        np.testing.assert_allclose(out, v, atol=1e-5)
+
+    def test_crop3d_rejects_out_of_bounds(self):
+        from analytics_zoo_tpu.feature import Crop3D
+
+        v = self.vol()
+        with pytest.raises(ValueError, match="does not fit"):
+            Crop3D((10, 0, 0), (4, 4, 4)).apply_image(v)
+        with pytest.raises(ValueError, match="invalid"):
+            Crop3D((-1, 0, 0), (4, 4, 4))
