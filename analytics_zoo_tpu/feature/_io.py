@@ -1,0 +1,22 @@
+"""Shared corpus-directory walking for ImageSet.read / TextSet.read."""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional, Tuple
+
+
+def walk_class_folders(path: str
+                       ) -> Iterator[Tuple[str, Optional[int]]]:
+    """Yield (file_path, label) over a class-per-subfolder dataset dir
+    (label = 0-based sorted-subfolder index). A flat folder of files
+    yields them with label None."""
+    classes = sorted(d for d in os.listdir(path)
+                     if os.path.isdir(os.path.join(path, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    for c in classes or [""]:
+        sub = os.path.join(path, c) if c else path
+        for name in sorted(os.listdir(sub)):
+            fpath = os.path.join(sub, name)
+            if os.path.isfile(fpath):
+                yield fpath, label_of.get(c)

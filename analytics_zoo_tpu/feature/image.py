@@ -289,22 +289,17 @@ class ImageSet:
     @classmethod
     def read(cls, folder: str) -> "ImageSet":
         """Read a class-per-subfolder image directory
-        (ref: ImageSet.read; NNImageReader)."""
+        (ref: ImageSet.read; NNImageReader). A flat folder of images
+        reads with ``label=None``."""
         from PIL import Image
 
+        from analytics_zoo_tpu.feature._io import walk_class_folders
+
         feats = []
-        classes = sorted(d for d in os.listdir(folder)
-                         if os.path.isdir(os.path.join(folder, d)))
-        label_of = {c: i for i, c in enumerate(classes)}
-        for c in classes or [""]:
-            sub = os.path.join(folder, c)
-            for name in sorted(os.listdir(sub)):
-                path = os.path.join(sub, name)
-                if not os.path.isfile(path):
-                    continue
-                img = np.asarray(Image.open(path).convert("RGB"),
-                                 np.float32)
-                feats.append(ImageFeature(img, label_of.get(c), uri=path))
+        for path, label in walk_class_folders(folder):
+            img = np.asarray(Image.open(path).convert("RGB"),
+                             np.float32)
+            feats.append(ImageFeature(img, label, uri=path))
         return cls(feats)
 
     def transform(self, *ops: ImageProcessing) -> "ImageSet":

@@ -152,6 +152,21 @@ class TextSet:
         return cls([TextFeature(t, l) for t, l in zip(texts, labels)])
 
     @classmethod
+    def read(cls, path: str) -> "TextSet":
+        """Read a category-per-subfolder corpus (the news20 layout the
+        reference's TextClassification example uses; ref:
+        TextSet.read, text_set.py:302-331): each subfolder is a class,
+        each file one text; labels are 0-based in sorted-folder order.
+        A flat folder of files reads with ``label=None``."""
+        from analytics_zoo_tpu.feature._io import walk_class_folders
+
+        feats = []
+        for fpath, label in walk_class_folders(path):
+            with open(fpath, encoding="utf-8", errors="replace") as f:
+                feats.append(TextFeature(f.read(), label, uri=fpath))
+        return cls(feats)
+
+    @classmethod
     def read_csv(cls, path: str) -> "TextSet":
         """CSV rows of (uri/id, text) (ref: text_set.py:332-353)."""
         feats = []

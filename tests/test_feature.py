@@ -299,3 +299,26 @@ class TestImage3D:
             Crop3D((10, 0, 0), (4, 4, 4)).apply_image(v)
         with pytest.raises(ValueError, match="invalid"):
             Crop3D((-1, 0, 0), (4, 4, 4))
+
+
+class TestTextSetRead:
+    def test_read_folder_per_class(self, tmp_path):
+        for cls_name, texts in [("neg", ["bad terrible"]),
+                                ("pos", ["great movie", "loved it"])]:
+            d = tmp_path / cls_name
+            d.mkdir()
+            for i, t in enumerate(texts):
+                (d / f"{i}.txt").write_text(t)
+        ts = TextSet.read(str(tmp_path))
+        assert len(ts) == 3
+        assert sorted(set(ts.get_labels())) == [0, 1]
+        x, y = (ts.tokenize().word2idx().shape_sequence(len=4)
+                .generate_sample().to_arrays())
+        assert x.shape == (3, 4) and y.shape == (3,)
+
+    def test_read_flat_folder(self, tmp_path):
+        for i in range(2):
+            (tmp_path / f"{i}.txt").write_text("some words here")
+        ts = TextSet.read(str(tmp_path))
+        assert len(ts) == 2
+        assert ts.get_labels() == [None, None]
