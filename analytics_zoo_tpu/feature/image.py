@@ -16,7 +16,6 @@ HWC arrays (NHWC is the TPU-friendly layout XLA convolutions prefer --
 
 from __future__ import annotations
 
-import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np

@@ -4,3 +4,10 @@ from analytics_zoo_tpu.models.text.classifier import (  # noqa: F401
     TextClassifier,
 )
 from analytics_zoo_tpu.models.text.knrm import KNRM  # noqa: F401
+from analytics_zoo_tpu.models.text.bert_estimators import (  # noqa: F401
+    BERTClassifier,
+    BERTNER,
+)
+from analytics_zoo_tpu.models.text.bert_squad import (  # noqa: F401
+    BERTSQuAD,
+)

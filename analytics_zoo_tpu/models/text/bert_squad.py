@@ -41,7 +41,11 @@ def squad_span_loss(preds, labels):
 
 
 class BERTForSQuAD(nn.Module):
-    """BERT encoder + span head -> (start_logits, end_logits)."""
+    """BERT encoder + span head -> (start_logits, end_logits).
+
+    The encoder+head wiring is the shared ``_BERTHeadModule``
+    (per-token, 2 classes); this wrapper only splits the [B, L, 2]
+    logits into the (start, end) pair the SQuAD loss consumes."""
 
     vocab: int
     hidden_size: int = 768
@@ -50,20 +54,21 @@ class BERTForSQuAD(nn.Module):
     intermediate_size: int = 3072
     max_position_len: int = 512
     hidden_dropout: float = 0.1
-    attn_dropout: float = 0.0  # 0 keeps the flash kernel engaged
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        seq, _ = BERTModule(
-            vocab=self.vocab, hidden_size=self.hidden_size,
-            n_block=self.n_block, n_head=self.n_head,
+        from analytics_zoo_tpu.models.text.bert_estimators import (
+            _BERTHeadModule)
+
+        logits = _BERTHeadModule(
+            vocab=self.vocab, num_classes=2, per_token=True,
+            hidden_size=self.hidden_size, n_block=self.n_block,
+            n_head=self.n_head,
             intermediate_size=self.intermediate_size,
             max_position_len=self.max_position_len,
-            hidden_dropout=self.hidden_dropout,
-            attn_dropout=self.attn_dropout, dtype=self.dtype,
-            name="bert")(x, train=train)
-        logits = nn.Dense(2, dtype=jnp.float32, name="span_head")(seq)
+            hidden_dropout=self.hidden_dropout, dtype=self.dtype,
+            name="squad")(x, train=train)
         start, end = jnp.split(logits, 2, axis=-1)
         return start.squeeze(-1), end.squeeze(-1)
 

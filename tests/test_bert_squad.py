@@ -72,3 +72,50 @@ def test_decode_spans_respects_constraints():
     assert spans.shape == (5, 2)
     assert np.all(spans[:, 1] >= spans[:, 0])
     assert np.all(spans[:, 1] - spans[:, 0] < 5)
+
+
+class TestBERTClassifierAndNER:
+    """The other two TFPark BERT estimators (ref: bert_classifier.py,
+    bert_ner.py)."""
+
+    def tiny_kwargs(self):
+        return dict(vocab=60, hidden_size=16, n_block=1, n_head=2,
+                    intermediate_size=32, max_position_len=16)
+
+    def test_classifier_learns_token_presence(self):
+        from analytics_zoo_tpu.models.text import BERTClassifier
+
+        rng = np.random.RandomState(0)
+        n, seq = 128, 8
+        ids = rng.randint(2, 60, (n, seq)).astype(np.int32)
+        y = rng.randint(0, 2, n).astype(np.int32)
+        ids[y == 1, 0] = 1  # class marker token
+        model = BERTClassifier(num_classes=2, **self.tiny_kwargs())
+        model.fit(({"input_ids": ids}, y), batch_size=16, epochs=8)
+        res = model.evaluate(({"input_ids": ids}, y), batch_size=16)
+        assert res["accuracy"] > 0.9
+
+    def test_ner_tags_marker_tokens(self):
+        from analytics_zoo_tpu.models.text import BERTNER
+
+        rng = np.random.RandomState(1)
+        n, seq = 128, 8
+        ids = rng.randint(2, 60, (n, seq)).astype(np.int32)
+        tags = (ids < 30).astype(np.int32)  # tag = token-range rule
+        model = BERTNER(num_classes=2, **self.tiny_kwargs())
+        hist = model.fit(({"input_ids": ids}, tags), batch_size=16,
+                         epochs=16)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        logits = model.predict({"input_ids": ids[:32]}, batch_size=16)
+        acc = BERTNER.token_accuracy(logits, tags[:32])
+        assert acc > 0.85
+
+    def test_save_load_registry(self, tmp_path):
+        from analytics_zoo_tpu.models import ZooModel
+        from analytics_zoo_tpu.models.text import BERTClassifier
+
+        m = BERTClassifier(num_classes=2, **self.tiny_kwargs())
+        m.estimator._ensure_built(m._example_input())
+        m.save_model(str(tmp_path / "bc"))
+        m2 = ZooModel.load_model(str(tmp_path / "bc"))
+        assert type(m2).__name__ == "BERTClassifier"
