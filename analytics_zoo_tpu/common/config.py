@@ -30,6 +30,16 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.mesh.axis.sequence": "seq",
     "zoo.mesh.axis.pipeline": "pipe",
     "zoo.mesh.axis.expert": "expert",
+    # ops
+    # attention kernel dispatch: "auto" (flash on TPU when shapes
+    # allow, einsum otherwise), "flash", or "einsum". At short seqs
+    # (<=512) the materialized einsum path is often faster on TPU than
+    # a flash kernel at head_dim 64; auto picks per shape.
+    "zoo.ops.attention_impl": "auto",
+    # seq length at/below which auto prefers the einsum path (scores
+    # fit HBM comfortably and XLA's batched matmuls beat the blockwise
+    # kernel's VPU overhead at these sizes)
+    "zoo.ops.attention_flash_min_seq": 512,
     # data layer
     "zoo.data.prefetch_buffer": 2,
     "zoo.data.check_batch_divisible": True,      # ref: tf_dataset.py:142-147 batch % cores == 0

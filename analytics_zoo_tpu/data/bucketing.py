@@ -108,6 +108,16 @@ def fit_bucketed(estimator, buckets: SequenceBuckets, batch_size: int,
     per-bucket histories."""
     histories = []
     data = sorted(buckets, key=lambda t: -t[0])
+    skipped = sum(len(x) for _, x, _ in data if len(x) < batch_size)
+    if skipped:
+        # no silent caps: these sequences never train at this batch size
+        from analytics_zoo_tpu.common.log import get_logger
+
+        get_logger(__name__).warning(
+            "fit_bucketed: %d sequences sit in buckets smaller than "
+            "batch_size=%d and are skipped every pass -- lower "
+            "batch_size or widen the buckets to train on them",
+            skipped, batch_size)
     for _ in range(epochs):
         for _, x, y in data:
             if len(x) < batch_size:

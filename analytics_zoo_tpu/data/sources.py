@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from analytics_zoo_tpu.data.shard import XShards
+from analytics_zoo_tpu.utils import fileio
 
 
 def _expand(path) -> List[str]:
@@ -28,6 +29,23 @@ def _expand(path) -> List[str]:
         for p in path:
             files.extend(_expand(p))
         return files
+    if fileio.is_remote(path):
+        # URI datasets (gs://bucket/dir, memory://...) resolve through
+        # the filesystem layer; scheme is re-attached so downstream
+        # readers (pandas handles fsspec URLs natively) keep working
+        fs = fileio.get_filesystem(path)
+        scheme = str(path).split("://", 1)[0]
+        bare = str(path).split("://", 1)[1]
+        if fs.isdir(bare):
+            out = sorted(
+                f"{scheme}://{p}" for p in fs.ls(bare, detail=False)
+                if not os.path.basename(p).startswith((".", "_"))
+                and fs.isfile(p))
+        else:
+            out = sorted(f"{scheme}://{p}" for p in fs.glob(bare))
+        if not out:
+            raise FileNotFoundError(f"no files match {path!r}")
+        return out
     if os.path.isdir(path):
         return sorted(
             p for f in os.listdir(path)
@@ -86,15 +104,28 @@ def read_image_folder(path: str, image_size: Optional[tuple] = None,
     """
     from PIL import Image
 
-    classes = sorted(
-        d for d in os.listdir(path)
-        if os.path.isdir(os.path.join(path, d))) if with_label else []
-    entries: List[tuple] = []
-    if classes:
+    if fileio.is_remote(path):
+        fs = fileio.get_filesystem(path)
+        scheme = str(path).split("://", 1)[0]
+        bare = str(path).split("://", 1)[1]
+        classes = sorted(
+            os.path.basename(d.rstrip("/"))
+            for d in fs.ls(bare, detail=False)
+            if fs.isdir(d)) if with_label else []
+        entries: List[tuple] = []
+        for ci, c in enumerate(classes):
+            for f in sorted(fs.ls(f"{bare.rstrip('/')}/{c}",
+                                  detail=False)):
+                entries.append((f"{scheme}://{f}", ci))
+    else:
+        classes = sorted(
+            d for d in os.listdir(path)
+            if os.path.isdir(os.path.join(path, d))) if with_label else []
+        entries = []
         for ci, c in enumerate(classes):
             for f in sorted(os.listdir(os.path.join(path, c))):
                 entries.append((os.path.join(path, c, f), ci))
-    else:
+    if not classes:
         for f in _expand(path):
             entries.append((f, -1))
     if not entries:
@@ -104,7 +135,8 @@ def read_image_folder(path: str, image_size: Optional[tuple] = None,
     def load(group):
         xs, ys = [], []
         for fpath, label in group:
-            img = Image.open(fpath).convert("RGB")
+            with fileio.open_file(fpath, "rb") as fh:
+                img = Image.open(fh).convert("RGB")
             if image_size is not None:
                 img = img.resize((image_size[1], image_size[0]))
             xs.append(np.asarray(img, dtype=np.uint8))
@@ -222,6 +254,12 @@ def iter_tfrecord(path: str, verify: bool = False):
 
     from analytics_zoo_tpu import native
 
+    if fileio.is_remote(path):
+        # object stores have no mmap; one ranged read of the shard
+        buf = fileio.read_bytes(path)
+        for offset, length in native.scan_tfrecords(buf, verify=verify):
+            yield buf[offset:offset + length]
+        return
     with open(path, "rb") as f:
         size = os.fstat(f.fileno()).st_size
         if size == 0:

@@ -354,6 +354,13 @@ def _parse_caffe_blob(buf: bytes) -> np.ndarray:
     return arr
 
 
+# caffe.proto V1LayerParameter.LayerType values for layers that carry
+# weights (the rest parse fine as plain weight/bias layers or have none)
+_V1_LAYER_TYPES = {
+    4: "Convolution", 14: "InnerProduct", 39: "Deconvolution",
+}
+
+
 def import_caffe(path_or_bytes,
                  key_map: Optional[Dict[str, str]] = None) -> Dict:
     """``.caffemodel`` -> nested flax-style params dict
@@ -379,15 +386,27 @@ def import_caffe(path_or_bytes,
         name = ""
         ltype = ""
         blobs: List[np.ndarray] = []
-        for f2, _, v2 in _iter_fields(val):
+        for f2, w2, v2 in _iter_fields(val):
             if f2 == name_field and isinstance(v2, bytes):
                 name = v2.decode("utf-8", "replace")
             elif field == 100 and f2 == 2 and isinstance(v2, bytes):
                 ltype = v2.decode("utf-8", "replace")
+            elif field == 2 and f2 == 5 and w2 == 0:
+                # V1LayerParameter.type enum (caffe.proto LayerType);
+                # BVLC V1 has no BatchNorm/Scale values -- forks that
+                # back-ported BN disagree on the enum, so BN is instead
+                # recognized below by its blob signature
+                ltype = _V1_LAYER_TYPES.get(int(v2), "")
             elif f2 == (6 if field == 2 else 7):
                 blobs.append(_parse_caffe_blob(v2))
         if not name or not blobs:
             continue
+        if (field == 2 and not ltype and len(blobs) == 3
+                and blobs[2].size == 1 and blobs[0].ndim <= 1
+                and blobs[0].shape == blobs[1].shape):
+            # legacy 3-blob (mean-sum, var-sum, scalar factor) is the BN
+            # statistical layout regardless of the fork's enum value
+            ltype = "BatchNorm"
         parts = _apply_key_map(name, key_map).split("/")
         if ltype == "BatchNorm":
             # blobs: mean-sum, variance-sum, moving-average factor; the

@@ -98,6 +98,54 @@ class InferenceModel:
         params = import_torch_state_dict(state_dict, key_map=key_map)
         return self.load_flax(module, variables={wrap: params})
 
+    def load_graph(self, graph_fn) -> "InferenceModel":
+        """Serve an imported executable graph
+        (:class:`~analytics_zoo_tpu.inference.graph_executor.GraphFunction`)
+        through the bucketed-jit predict path. The execution analog of
+        the reference's TFNet/ONNX serving backends
+        (ref: InferenceModel.scala doLoadTensorflow -> TFNet session;
+        here the graph IS a jax function, so it shares predict/warm_up/
+        quantize infrastructure with native models)."""
+        # float weight constants ride as "variables" so quantize() can
+        # compress them and jit treats them as runtime operands; static
+        # operands (shapes/axes -- integer/scalar consts) stay baked
+        # into the graph so trace-time ops see concrete values
+        weights = graph_fn.weight_constants()
+        self.variables = {"graph_consts": weights}
+        for name in weights:
+            # drop the fp copies from the closure so quantize() actually
+            # releases the full-precision weights
+            graph_fn.constants.pop(name)
+        single = len(graph_fn.input_names) == 1
+
+        def apply_graph(variables, x):
+            feed = (x if isinstance(x, dict)
+                    else {graph_fn.input_names[0]: x} if single
+                    else dict(zip(graph_fn.input_names, x)))
+            return graph_fn.execute(feed,
+                                    constants=variables["graph_consts"])
+
+        self._apply_fn = apply_graph
+        return self
+
+    def load_tf_graph(self, path_or_bytes, inputs=None, outputs=None
+                      ) -> "InferenceModel":
+        """Frozen TF GraphDef -> executable serving model
+        (ref: doLoadTensorflow frozen path, TFNet.scala:56-719)."""
+        from analytics_zoo_tpu.inference.graph_executor import (
+            load_tf_frozen_graph)
+
+        return self.load_graph(load_tf_frozen_graph(
+            path_or_bytes, inputs=inputs, outputs=outputs))
+
+    def load_onnx(self, path_or_bytes) -> "InferenceModel":
+        """ONNX model -> executable serving model
+        (ref: onnx_loader.py:32-128)."""
+        from analytics_zoo_tpu.inference.graph_executor import (
+            load_onnx_model)
+
+        return self.load_graph(load_onnx_model(path_or_bytes))
+
     def load_encrypted_zoo(self, path: str, secret: str,
                            ) -> "InferenceModel":
         """Directory of encrypted files produced by ``save_encrypted``

@@ -23,6 +23,7 @@ from flax import serialization
 
 from analytics_zoo_tpu.common.log import get_logger
 from analytics_zoo_tpu.parallel import sharding as sharding_lib
+from analytics_zoo_tpu.utils import fileio
 
 logger = get_logger(__name__)
 
@@ -37,28 +38,27 @@ def save_checkpoint(ckpt_dir: str, variables: Any, opt_state: Any,
     host_vars = sharding_lib.gather_to_host(variables)
     host_opt = sharding_lib.gather_to_host(opt_state)
     if jax.process_index() == 0:
-        os.makedirs(ckpt_dir, exist_ok=True)
-        _atomic_write(os.path.join(ckpt_dir, f"model.{step}"),
+        fileio.makedirs(ckpt_dir, exist_ok=True)
+        _atomic_write(fileio.join(ckpt_dir, f"model.{step}"),
                       serialization.to_bytes(host_vars))
-        _atomic_write(os.path.join(ckpt_dir, f"optim.{step}"),
+        _atomic_write(fileio.join(ckpt_dir, f"optim.{step}"),
                       serialization.to_bytes(host_opt))
         meta = {"step": int(step), "epoch": int(epoch)}
         if extra_meta:
             meta.update(extra_meta)
-        _atomic_write(os.path.join(ckpt_dir, f"meta.{step}.json"),
+        _atomic_write(fileio.join(ckpt_dir, f"meta.{step}.json"),
                       json.dumps(meta).encode())
-        _atomic_write(os.path.join(ckpt_dir, "latest"), str(step).encode())
+        _atomic_write(fileio.join(ckpt_dir, "latest"), str(step).encode())
         logger.info("checkpoint saved: %s step=%d", ckpt_dir, step)
     _barrier()
-    return os.path.join(ckpt_dir, f"model.{step}")
+    return fileio.join(ckpt_dir, f"model.{step}")
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
-    path = os.path.join(ckpt_dir, "latest")
-    if not os.path.isfile(path):
+    path = fileio.join(ckpt_dir, "latest")
+    if not fileio.exists(path):
         return None
-    with open(path) as f:
-        return int(f.read().strip())
+    return int(fileio.read_bytes(path).decode().strip())
 
 
 def load_checkpoint(ckpt_dir: str, variables_template: Any,
@@ -73,33 +73,38 @@ def load_checkpoint(ckpt_dir: str, variables_template: Any,
         step = latest_step(ckpt_dir)
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
-    with open(os.path.join(ckpt_dir, f"model.{step}"), "rb") as f:
-        data = f.read()
-        if variables_template is None:
-            variables = serialization.msgpack_restore(data)
-        else:
-            variables = serialization.from_bytes(
-                jax.device_get(variables_template), data)
+    data = fileio.read_bytes(fileio.join(ckpt_dir, f"model.{step}"))
+    if variables_template is None:
+        variables = serialization.msgpack_restore(data)
+    else:
+        variables = serialization.from_bytes(
+            jax.device_get(variables_template), data)
     if opt_state_template is None:
         opt_state = None  # caller only wants model variables
     else:
-        with open(os.path.join(ckpt_dir, f"optim.{step}"), "rb") as f:
-            try:
-                opt_state = serialization.from_bytes(
-                    jax.device_get(opt_state_template), f.read())
-            except ValueError as e:
-                raise ValueError(
-                    "optimizer state in the checkpoint does not match this "
-                    "Estimator's optimizer config (optimizer type and "
-                    "clip_norm/clip_value must match the run that saved "
-                    f"it): {e}") from e
-    with open(os.path.join(ckpt_dir, f"meta.{step}.json")) as f:
-        meta = json.load(f)
+        raw = fileio.read_bytes(fileio.join(ckpt_dir, f"optim.{step}"))
+        try:
+            opt_state = serialization.from_bytes(
+                jax.device_get(opt_state_template), raw)
+        except ValueError as e:
+            raise ValueError(
+                "optimizer state in the checkpoint does not match this "
+                "Estimator's optimizer config (optimizer type and "
+                "clip_norm/clip_value must match the run that saved "
+                f"it): {e}") from e
+    meta = json.loads(fileio.read_bytes(
+        fileio.join(ckpt_dir, f"meta.{step}.json")).decode())
     logger.info("checkpoint restored: %s step=%d", ckpt_dir, step)
     return variables, opt_state, meta
 
 
 def _atomic_write(path: str, data: bytes) -> None:
+    if fileio.is_remote(path):
+        # object-store writes are already all-or-nothing at commit
+        # (no partially-visible object), which is the property the
+        # local tmp+rename dance buys
+        fileio.write_bytes(path, data)
+        return
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)

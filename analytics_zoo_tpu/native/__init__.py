@@ -151,21 +151,43 @@ class CorruptRecordError(ValueError):
     pass
 
 
+# per-pass entry cap: two u64 arrays at 64Ki entries = 1 MB resident,
+# independent of shard size (a worst-case cap of len(buf)//16 would
+# allocate host memory on the order of the file itself for multi-GB
+# shards, defeating the mmap'd O(1)-resident scan)
+_SCAN_CAP = 65536
+
+
 def scan_tfrecords(buf, verify: bool = False) -> List[Tuple[int, int]]:
     """All (payload_offset, payload_length) frames in a TFRecord
     buffer. ``verify=True`` checks both masked CRCs per record and
-    raises CorruptRecordError naming the first bad record."""
+    raises CorruptRecordError naming the first bad record. Scans in
+    fixed-size passes (bounded host allocation), resuming after the
+    last complete record of each pass."""
     if not available():
         return _py_scan(buf, verify)
     n = len(buf)
     ptr, keep = _as_ptr(buf)
+    out: List[Tuple[int, int]] = []
     try:
-        # worst case: empty payloads -> every 16 bytes is a record
-        cap = max(n // 16, 1)
+        cap = min(max(n // 16, 1), _SCAN_CAP)
         offs = (ctypes.c_uint64 * cap)()
         lens = (ctypes.c_uint64 * cap)()
-        got = _lib.zoo_scan_tfrecords(ptr, n, offs, lens, cap,
-                                      1 if verify else 0)
+        base = ctypes.cast(ptr, ctypes.c_void_p).value
+        pos = 0
+        while pos < n:
+            got = _lib.zoo_scan_tfrecords(
+                ctypes.c_void_p(base + pos), n - pos, offs, lens, cap,
+                1 if verify else 0)
+            if got < 0:
+                raise CorruptRecordError(
+                    f"record {len(out) + (-got - 1)} failed crc check")
+            for i in range(got):
+                out.append((pos + int(offs[i]), int(lens[i])))
+            if got < cap:
+                break  # tail reached (or trailing partial record)
+            last_off, last_len = out[-1]
+            pos = last_off + last_len + 4  # skip trailing payload crc
     finally:
         was_view = not isinstance(buf, (bytes, bytearray))
         del ptr, keep
@@ -175,9 +197,7 @@ def scan_tfrecords(buf, verify: bool = False) -> List[Tuple[int, int]]:
             import gc
 
             gc.collect()
-    if got < 0:
-        raise CorruptRecordError(f"record {-got - 1} failed crc check")
-    return [(int(offs[i]), int(lens[i])) for i in range(got)]
+    return out
 
 
 def _py_scan(buf, verify: bool) -> List[Tuple[int, int]]:

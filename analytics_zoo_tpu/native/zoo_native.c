@@ -18,7 +18,11 @@
 /* ----------------------------- crc32c (Castagnoli), slicing-by-8 ---- */
 
 static uint32_t crc_table[8][256];
-static int table_ready = 0;
+
+/* Filled once at library load (constructor) -- lazy init guarded by a
+ * plain flag was a C data race when the event-writer thread and data
+ * loader threads both hit the first call concurrently. */
+static void init_table(void) __attribute__((constructor));
 
 static void init_table(void) {
     uint32_t poly = 0x82F63B78u; /* reflected 0x1EDC6F41 */
@@ -35,11 +39,9 @@ static void init_table(void) {
             crc_table[s][i] = crc;
         }
     }
-    table_ready = 1;
 }
 
 uint32_t zoo_crc32c(const uint8_t *buf, size_t len) {
-    if (!table_ready) init_table();
     uint32_t crc = 0xFFFFFFFFu;
     while (len >= 8) {
         crc ^= (uint32_t)buf[0] | ((uint32_t)buf[1] << 8) |

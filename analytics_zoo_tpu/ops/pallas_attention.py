@@ -37,13 +37,18 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _auto_block(length: int) -> int:
-    """Largest 128-multiple block <= 1024 dividing ``length``: big blocks
-    amortize the per-block VPU softmax work against the MXU matmuls
-    (measured ~2.5x fwd+bwd at L=4096 vs 128-blocks) while staying
-    inside VMEM (s/p tiles at [1024, 1024] f32 = 4 MB each)."""
+def _auto_block(length: int, cap: int = 1024) -> int:
+    """Largest 128-multiple block <= ``cap`` dividing ``length``: big
+    blocks amortize the per-block VPU softmax work against the MXU
+    matmuls (measured ~2.5x fwd+bwd at L=4096 vs 128-blocks) while
+    staying inside VMEM (s/p tiles at [1024, 1024] f32 = 4 MB each).
+
+    The backward kernels pass cap=512: they hold three [BQ, BK] f32
+    intermediates (s, p, dp) plus q/k/v/do/lse/delta tiles and scratch,
+    which at 1024^2 blocks (~12 MB of intermediates alone) would run
+    into the ~16 MB per-core VMEM budget of v4/v5e."""
     for b in (1024, 896, 768, 640, 512, 384, 256, 128):
-        if length % b == 0:
+        if b <= cap and length % b == 0:
             return b
     return 128
 
@@ -270,8 +275,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
                block_q: int, block_k: int):
     b, h, l, d = q.shape
     lk = k.shape[2]
-    block_q = block_q or _auto_block(l)
-    block_k = block_k or _auto_block(lk)
+    block_q = block_q or _auto_block(l, cap=512)
+    block_k = block_k or _auto_block(lk, cap=512)
     bh = b * h
     qr = q.reshape(bh, l, d)
     kr = k.reshape(bh, lk, d)

@@ -1,0 +1,130 @@
+"""URI-based filesystem layer: one IO surface for local paths and
+remote schemes (gs://, s3://, hdfs://, memory://...).
+
+The analog of the reference's transparent local/HDFS/S3 file utilities
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/common/Utils.scala --
+``saveBytes``/``readBytes`` dispatch on the Hadoop FileSystem of the
+URI). On a TPU pod, datasets, checkpoints and TB event files live in
+GCS; every framework IO path (data/sources.py, learn/checkpoint.py,
+utils/summary.py) routes through here so any fsspec scheme works.
+
+Local paths (no scheme) use plain ``os``/``open`` -- no dependency and
+no behavior change. Scheme'd paths use ``fsspec`` when available;
+without fsspec a clear error names the missing capability instead of
+silently writing a local file literally named "gs:/...".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import IO, List, Optional
+
+__all__ = ["is_remote", "open_file", "read_bytes", "write_bytes",
+           "exists", "makedirs", "listdir", "remove", "rename",
+           "get_filesystem"]
+
+
+def is_remote(path: str) -> bool:
+    """True for scheme'd URIs (``gs://...``); ``file://`` counts as
+    remote so it also routes through fsspec's normalization."""
+    return "://" in str(path)
+
+
+def get_filesystem(path: str):
+    """The fsspec filesystem owning ``path`` (remote paths only)."""
+    try:
+        import fsspec
+    except ImportError as e:  # pragma: no cover - fsspec is baked in
+        raise RuntimeError(
+            f"path {path!r} needs fsspec for scheme'd URIs; install "
+            "fsspec or use a local path") from e
+    fs, _ = fsspec.core.url_to_fs(str(path))
+    return fs
+
+
+def _strip(path: str) -> str:
+    """fsspec methods want the path without the scheme for some
+    filesystems; url_to_fs returns the normalized form."""
+    import fsspec
+
+    _, p = fsspec.core.url_to_fs(str(path))
+    return p
+
+
+def open_file(path: str, mode: str = "rb") -> IO:
+    if is_remote(path):
+        import fsspec
+
+        return fsspec.open(str(path), mode).open()
+    if any(m in mode for m in ("w", "a", "x")):
+        parent = os.path.dirname(str(path))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+    return open(path, mode)
+
+
+def read_bytes(path: str) -> bytes:
+    with open_file(path, "rb") as f:
+        return f.read()
+
+
+def write_bytes(path: str, data: bytes) -> None:
+    with open_file(path, "wb") as f:
+        f.write(data)
+
+
+def exists(path: str) -> bool:
+    if is_remote(path):
+        return get_filesystem(path).exists(_strip(path))
+    return os.path.exists(path)
+
+
+def makedirs(path: str, exist_ok: bool = True) -> None:
+    if is_remote(path):
+        get_filesystem(path).makedirs(_strip(path), exist_ok=exist_ok)
+    else:
+        os.makedirs(path, exist_ok=exist_ok)
+
+
+def listdir(path: str) -> List[str]:
+    """Base names of entries under ``path`` (non-recursive)."""
+    if is_remote(path):
+        fs = get_filesystem(path)
+        return sorted(os.path.basename(p.rstrip("/"))
+                      for p in fs.ls(_strip(path), detail=False))
+    return sorted(os.listdir(path))
+
+
+def remove(path: str, recursive: bool = False) -> None:
+    if is_remote(path):
+        get_filesystem(path).rm(_strip(path), recursive=recursive)
+    elif recursive and os.path.isdir(path):
+        import shutil
+
+        shutil.rmtree(path)
+    else:
+        os.remove(path)
+
+
+def rename(src: str, dst: str) -> None:
+    """Atomic for local paths; copy-delete semantics on object stores
+    (fsspec mv), which is the same guarantee the reference's HDFS/S3
+    rename gives."""
+    if is_remote(src) or is_remote(dst):
+        if not (is_remote(src) and is_remote(dst)):
+            raise ValueError("rename across local/remote is not "
+                             "supported; copy explicitly")
+        get_filesystem(src).mv(_strip(src), _strip(dst), recursive=True)
+    else:
+        os.replace(src, dst)
+
+
+def join(base: str, *parts: str) -> str:
+    """Path join that preserves URI schemes (os.path.join would eat
+    the double slash on some platforms)."""
+    if is_remote(base):
+        out = str(base).rstrip("/")
+        for p in parts:
+            out += "/" + str(p).strip("/")
+        return out
+    return os.path.join(base, *parts)

@@ -23,6 +23,7 @@ Readback (``read_events``) supports the Estimator's
 
 from __future__ import annotations
 
+import io
 import itertools
 import os
 import socket
@@ -193,7 +194,9 @@ def _write_record(f, payload: bytes) -> None:
 
 def _read_records(path: str) -> Iterator[bytes]:
     """Yield records, stopping at the first truncated or CRC-corrupt one."""
-    with open(path, "rb") as f:
+    from analytics_zoo_tpu.utils import fileio
+
+    with fileio.open_file(path, "rb") as f:
         while True:
             header = f.read(8)
             if len(header) < 8:
@@ -216,6 +219,32 @@ def _read_records(path: str) -> Iterator[bytes]:
 # -------------------------------------------------------------- writer ---
 
 
+class _RewriteOnFlushFile:
+    """File-like sink for object stores: buffers writes and publishes
+    the full object on flush/close (append does not exist there, and
+    fsspec's buffered 'wb' streams only become visible at close)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._buf = io.BytesIO()
+        self._dirty = False
+
+    def write(self, data: bytes) -> int:
+        self._dirty = True
+        return self._buf.write(data)
+
+    def flush(self) -> None:
+        if not self._dirty:
+            return
+        from analytics_zoo_tpu.utils import fileio
+
+        fileio.write_bytes(self._path, self._buf.getvalue())
+        self._dirty = False
+
+    def close(self) -> None:
+        self.flush()
+
+
 class SummaryWriter:
     """Append-only TB event writer for one log dir.
 
@@ -224,7 +253,9 @@ class SummaryWriter:
     """
 
     def __init__(self, log_dir: str, flush_every: int = 20):
-        os.makedirs(log_dir, exist_ok=True)
+        from analytics_zoo_tpu.utils import fileio
+
+        fileio.makedirs(log_dir, exist_ok=True)
         self.log_dir = log_dir
         # hostname+pid uniquify the file so concurrent writers (train +
         # validation, or multiple worker processes) never interleave
@@ -232,8 +263,15 @@ class SummaryWriter:
         fname = (f"events.out.tfevents.{int(time.time())}."
                  f"{socket.gethostname()}.{os.getpid()}"
                  f".{next(_WRITER_COUNTER)}.analytics-zoo-tpu")
-        self._path = os.path.join(log_dir, fname)
-        self._file = open(self._path, "ab")
+        self._path = fileio.join(log_dir, fname)
+        # remote event files (gs://...): object stores have no append
+        # and fsspec buffered streams only publish at close(), so the
+        # writer accumulates records in memory and rewrites the whole
+        # object on flush -- events stay readable mid-run and a crash
+        # loses at most one flush interval (event files are KBs/run)
+        self._file = (_RewriteOnFlushFile(self._path)
+                      if fileio.is_remote(self._path)
+                      else fileio.open_file(self._path, "ab"))
         self._lock = threading.Lock()
         self._pending = 0
         self._flush_every = flush_every
@@ -312,7 +350,18 @@ def read_events(log_dir_or_file: str) -> Dict[str, List[Tuple[int, float]]]:
     Supports ``get_train_summary``-style readback
     (ref: Topology.scala:1390-1404).
     """
-    if os.path.isdir(log_dir_or_file):
+    from analytics_zoo_tpu.utils import fileio
+
+    if fileio.is_remote(log_dir_or_file):
+        fs = fileio.get_filesystem(log_dir_or_file)
+        scheme, bare = str(log_dir_or_file).split("://", 1)
+        if fs.isdir(bare):
+            files = sorted(f"{scheme}://{p}"
+                           for p in fs.ls(bare, detail=False)
+                           if "tfevents" in os.path.basename(p))
+        else:
+            files = [log_dir_or_file]
+    elif os.path.isdir(log_dir_or_file):
         files = sorted(
             os.path.join(log_dir_or_file, f)
             for f in os.listdir(log_dir_or_file)

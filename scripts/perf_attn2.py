@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Round 2: splash kernel, fwd/bwd split, clean in-jit matmul roofline,
+score-dtype variants. BERT-base shapes."""
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+
+PEAK = 197e12
+
+
+def sync(r):
+    leaf = jax.tree_util.tree_leaves(r)[0]
+    val = leaf if getattr(leaf, "ndim", 0) == 0 else jnp.sum(leaf)
+    float(jax.device_get(val))
+
+
+def chain(name, step_fn, x, iters, flops):
+    @jax.jit
+    def run(x):
+        return jax.lax.fori_loop(0, iters, step_fn, x)
+
+    t0 = time.perf_counter()
+    sync(run(x))
+    comp = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sync(run(x))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name}: {dt*1e3:.2f} ms, {flops/dt/1e12:.1f} TF/s "
+          f"({flops/dt/PEAK*100:.0f}% peak, compile {comp:.0f}s)",
+          flush=True)
+    return dt
+
+
+def matmul_roofline():
+    a = jax.random.normal(jax.random.PRNGKey(0), (4096, 4096),
+                          jnp.bfloat16)
+
+    def body(i, a):
+        return (a @ a) * 0.0001 + a
+
+    chain("matmul4096_chain", body, a, 30, 2 * 4096**3)
+    # K=64 contraction matmul (the attention shape problem)
+    b = jax.random.normal(jax.random.PRNGKey(1), (4096, 64),
+                          jnp.bfloat16)
+
+    def body2(i, b):
+        s = b @ (b.T @ b) * 1e-6  # [4096,64]@[64,64]? no: b.T@b=[64,64]
+        return b + s
+
+    chain("matmulK64_chain", body2, b, 30,
+          2 * 4096 * 64 * 64 * 2)
+
+
+def attn_fwd_only(b, h, l, d):
+    from analytics_zoo_tpu.ops.attention import reference_attention
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, l, d),
+                          jnp.bfloat16)
+
+    def body(i, q):
+        o = reference_attention(q, q, q)
+        return q + 0.0001 * o.astype(q.dtype)
+
+    chain(f"einsum_fwd b{b}", body, q, 20, 4 * b * h * l * l * d)
+
+
+def attn_fwd_f32_scores(b, h, l, d):
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, l, d),
+                          jnp.bfloat16)
+    scale = 1.0 / np.sqrt(d)
+
+    def attn(q, k, v):
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    def body(i, q):
+        def loss(q):
+            return jnp.sum(attn(q, q, q).astype(jnp.float32))
+
+        return q + 0.0001 * jax.grad(loss)(q).astype(q.dtype)
+
+    chain(f"einsum_f32sm b{b}", body, q, 20,
+          3.5 * 4 * b * h * l * l * d)
+
+
+def splash(b, h, l, d):
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk, splash_attention_mask as sm)
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, l, d),
+                          jnp.bfloat16)
+    mask = sm.MultiHeadMask(
+        [sm.FullMask((l, l)) for _ in range(h)])
+    kernel = sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1)
+    kernel = jax.vmap(kernel)
+    scale = 1.0 / np.sqrt(d)
+
+    def body(i, q):
+        def loss(q):
+            return jnp.sum(kernel(q * scale, q, q).astype(jnp.float32))
+
+        return q + 0.0001 * jax.grad(loss)(q).astype(q.dtype)
+
+    chain(f"splash b{b}", body, q, 20, 3.5 * 4 * b * h * l * l * d)
+
+
+def bert_fwd_vs_step(batch):
+    from analytics_zoo_tpu.models.text.bert_squad import (
+        BERTForSQuAD, squad_span_loss)
+    mod = BERTForSQuAD(vocab=30522, dtype=jnp.bfloat16)
+    seq = 384
+    x = {"input_ids": np.random.RandomState(0).randint(
+        0, 30522, (batch, seq)).astype(np.int32)}
+    variables = mod.init(jax.random.PRNGKey(0),
+                         {"input_ids": x["input_ids"][:1]}, train=False)
+
+    @jax.jit
+    def fwd(v, x):
+        s, e = mod.apply(v, x, train=False)
+        return jnp.sum(s.astype(jnp.float32))
+
+    t0 = time.perf_counter()
+    sync(fwd(variables, x))
+    comp = time.perf_counter() - t0
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fwd(variables, x)
+    sync(r)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"BERT fwd-only b{batch}: {dt*1e3:.1f} ms "
+          f"(compile {comp:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    print(jax.devices(), flush=True)
+    matmul_roofline()
+    attn_fwd_only(32, 12, 384, 64)
+    attn_fwd_f32_scores(32, 12, 384, 64)
+    try:
+        splash(32, 12, 384, 64)
+    except Exception as e:
+        print(f"splash failed: {type(e).__name__}: {e}", flush=True)
+    bert_fwd_vs_step(32)

@@ -58,6 +58,27 @@ class TestScanTFRecords:
         frames = native.scan_tfrecords(buf[:-3])
         assert len(frames) == 1
 
+    def test_chunked_scan_resumes_past_cap(self, monkeypatch):
+        # with a tiny per-pass cap the scan must resume after each pass
+        # and still return every frame with global offsets
+        if not native.available():
+            pytest.skip("no C compiler")
+        monkeypatch.setattr(native, "_SCAN_CAP", 3)
+        rng = np.random.RandomState(2)
+        payloads = [rng.bytes(n) for n in
+                    (0, 5, 17, 300, 4, 9, 1, 2048, 33, 12)]
+        buf = make_tfrecord_bytes(payloads)
+        frames = native.scan_tfrecords(buf)
+        assert frames == native._py_scan(buf, False)
+        for (off, ln), p in zip(frames, payloads):
+            assert buf[off:off + ln] == p
+        # corruption index stays global when the bad record is past cap
+        bad = bytearray(buf)
+        off, _ = frames[7]
+        bad[off] ^= 0xFF
+        with pytest.raises(native.CorruptRecordError, match="record 7"):
+            native.scan_tfrecords(bytes(bad), verify=True)
+
     def test_python_fallback_agrees(self):
         payloads = [b"a" * 10, b"bb" * 40]
         buf = make_tfrecord_bytes(payloads)
