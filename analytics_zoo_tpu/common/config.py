@@ -24,6 +24,11 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.train.failure.retry_interval_s": 120,   # ref: bigdl.failure.retryTimeInterval
     "zoo.train.log_every_n_steps": 50,
     "zoo.train.donate_buffers": True,
+    # training PRNG stream (dropout masks, epoch shuffles): "auto" uses
+    # the hardware RBG generator on TPU -- threefry2x32 dropout costs
+    # ~23 ms/step on BERT-base b32/L384 v5e (MFU 0.35 -> 0.42 measured)
+    # -- and threefry elsewhere; set explicitly to pin an impl
+    "zoo.train.prng_impl": "auto",
     # mesh / parallelism axis names
     "zoo.mesh.axis.data": "data",
     "zoo.mesh.axis.model": "model",
@@ -49,6 +54,10 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.serving.http_port": 10020,
     # inference
     "zoo.inference.default_dtype": "bfloat16",
+    # XLA persistent compilation cache (see common.context.
+    # enable_compilation_cache); "" disables
+    "zoo.compile_cache.dir": "~/.cache/analytics-zoo-tpu/xla-cache",
+    "zoo.compile_cache.min_compile_secs": 2.0,
 }
 
 _ENV_PREFIX = "AZT_"

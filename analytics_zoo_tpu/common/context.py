@@ -32,6 +32,45 @@ from analytics_zoo_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
 
+_cache_enabled = False
+_cache_lock = threading.Lock()
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
+    """Point XLA's persistent compilation cache at a durable directory so
+    the first-compile tax (200 s for BERT-base, ~30 s for NCF on v5e) is
+    paid once per machine, not once per process. Serving restarts and
+    preemption-resumes then start at steady-state speed.
+
+    Idempotent; called automatically by ``init_zoo_context``, the
+    Estimator, and ``InferenceModel``. Configure with
+    ``zoo.compile_cache.dir`` ("" disables) and
+    ``zoo.compile_cache.min_compile_secs``. The dir accepts any fileio
+    URI (``gs://...`` via fsspec) -- on a pod, point every host at the
+    same bucket."""
+    global _cache_enabled
+    with _cache_lock:
+        if _cache_enabled:
+            return
+        import os
+
+        cfg = get_config()
+        cache_dir = cache_dir or cfg.get("zoo.compile_cache.dir")
+        if not cache_dir:
+            return
+        cache_dir = os.path.expanduser(str(cache_dir))
+        try:
+            if "://" not in cache_dir:
+                os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(cfg.get("zoo.compile_cache.min_compile_secs", 2.0)))
+            _cache_enabled = True
+            logger.info("XLA persistent compilation cache: %s", cache_dir)
+        except Exception as e:  # cache is an optimization, never fatal
+            logger.warning("compilation cache unavailable: %s", e)
+
 
 class ZooContext:
     """Singleton runtime context.
@@ -165,6 +204,7 @@ def init_zoo_context(
         if conf:
             for k, v in conf.items():
                 config.set(k, v)
+        enable_compilation_cache()
 
         try:
             ctx = ZooContext(cluster_mode=cluster_mode, mesh_shape=mesh_shape,

@@ -37,10 +37,8 @@ def _expand(path) -> List[str]:
         scheme = str(path).split("://", 1)[0]
         bare = str(path).split("://", 1)[1]
         if fs.isdir(bare):
-            out = sorted(
-                f"{scheme}://{p}" for p in fs.ls(bare, detail=False)
-                if not os.path.basename(p).startswith((".", "_"))
-                and fs.isfile(p))
+            out = [u for u in fileio.listdir_uris(path, kind="file")
+                   if not os.path.basename(u).startswith((".", "_"))]
         else:
             out = sorted(f"{scheme}://{p}" for p in fs.glob(bare))
         if not out:
@@ -105,18 +103,15 @@ def read_image_folder(path: str, image_size: Optional[tuple] = None,
     from PIL import Image
 
     if fileio.is_remote(path):
-        fs = fileio.get_filesystem(path)
-        scheme = str(path).split("://", 1)[0]
-        bare = str(path).split("://", 1)[1]
         classes = sorted(
-            os.path.basename(d.rstrip("/"))
-            for d in fs.ls(bare, detail=False)
-            if fs.isdir(d)) if with_label else []
+            os.path.basename(d.rstrip("/")) for d in
+            fileio.listdir_uris(path, kind="directory")
+        ) if with_label else []
         entries: List[tuple] = []
         for ci, c in enumerate(classes):
-            for f in sorted(fs.ls(f"{bare.rstrip('/')}/{c}",
-                                  detail=False)):
-                entries.append((f"{scheme}://{f}", ci))
+            for f in fileio.listdir_uris(fileio.join(path, c),
+                                         kind="file"):
+                entries.append((f, ci))
     else:
         classes = sorted(
             d for d in os.listdir(path)

@@ -444,8 +444,13 @@ def _tf_conv_padding(attrs, ins_rank=4):
         pad = pad.decode()
     if pad == "EXPLICIT":
         ep = attrs.get("explicit_paddings") or []
-        return [(int(ep[2 * i]), int(ep[2 * i + 1]))
-                for i in range(ins_rank)][1:3]
+        pairs = [(int(ep[2 * i]), int(ep[2 * i + 1]))
+                 for i in range(ins_rank)]
+        # spatial dims sit at 1:3 for NHWC but 2:4 for NCHW
+        df = attrs.get("data_format", "NHWC") or "NHWC"
+        if isinstance(df, bytes):
+            df = df.decode()
+        return pairs[2:4] if df == "NCHW" else pairs[1:3]
     return pad
 
 
@@ -513,7 +518,7 @@ def _tf_bias_add(node, env, x, b):
 def _tf_fused_bn(node, env, x, scale, offset, mean, var):
     import jax.numpy as jnp
 
-    eps = node.attrs.get("epsilon") or 1e-3
+    eps = _attr(node.attrs, "epsilon", 1e-3)
     df = node.attrs.get("data_format", "NHWC") or "NHWC"
     shape = ((1, -1) + (1,) * (x.ndim - 2)) if df == "NCHW" \
         else ((1,) * (x.ndim - 1) + (-1,))
@@ -588,7 +593,7 @@ def _make_tf_ops() -> Dict[str, Callable]:
         "Relu": _unary(jax.nn.relu),
         "Relu6": _unary(lambda x: jnp.clip(x, 0, 6)),
         "LeakyRelu": lambda n, e, x: jax.nn.leaky_relu(
-            x, n.attrs.get("alpha") or 0.2),
+            x, _attr(n.attrs, "alpha", 0.2)),
         "Elu": _unary(jax.nn.elu),
         "Selu": _unary(jax.nn.selu),
         "Softplus": _unary(jax.nn.softplus),
@@ -840,6 +845,12 @@ def _onnx_pads(attrs, spatial: int, in_sizes=None, kernel=None,
             for i in range(spatial)]
 
 
+def _attr(attrs, name, default):
+    """Numeric attribute with a default -- explicit 0.0 is preserved
+    (`or`-style defaults wrongly coerce falsy zeros)."""
+    return float(attrs[name]) if name in attrs else float(default)
+
+
 def _onnx_conv(node, env, x, w, *maybe_b):
     import jax.lax as lax
 
@@ -848,13 +859,14 @@ def _onnx_conv(node, env, x, w, *maybe_b):
     strides = a.get("strides") or [1] * spatial
     dil = a.get("dilations") or [1] * spatial
     groups = int(a.get("group") or 1)
-    dn = lax.conv_dimension_numbers(
-        x.shape, w.shape,
-        ("NCHW", "OIHW", "NCHW") if spatial == 2 else
-        ("NCW"[:spatial + 1] + "H" * 0, "OIW", "NCW"))
-    if spatial == 1:
-        dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                        ("NCH", "OIH", "NCH"))
+    # channel-first specs per rank: 1-D uses the H label (any single
+    # spatial letter works for lax), 3-D appends D
+    specs = {1: ("NCH", "OIH", "NCH"),
+             2: ("NCHW", "OIHW", "NCHW"),
+             3: ("NCHWD", "OIHWD", "NCHWD")}
+    if spatial not in specs:
+        raise ValueError(f"Conv with {spatial} spatial dims unsupported")
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, specs[spatial])
     out = lax.conv_general_dilated(
         x, w, window_strides=[int(s) for s in strides],
         padding=_onnx_pads(a, spatial, in_sizes=x.shape[2:],
@@ -871,8 +883,9 @@ def _onnx_gemm(node, env, a, b, *maybe_c):
     import jax.numpy as jnp
 
     at = node.attrs
-    alpha = at.get("alpha", 1.0) or 1.0
-    beta = at.get("beta", 1.0) or 1.0
+    # explicit 0.0 is meaningful (beta=0 detaches C) -- no `or` coercion
+    alpha = float(at["alpha"]) if "alpha" in at else 1.0
+    beta = float(at["beta"]) if "beta" in at else 1.0
     if at.get("transA"):
         a = a.T
     if at.get("transB"):
@@ -912,7 +925,7 @@ def _onnx_pool(node, env, x, kind):
 def _onnx_bn(node, env, x, scale, bias, mean, var):
     import jax.numpy as jnp
 
-    eps = node.attrs.get("epsilon", 1e-5) or 1e-5
+    eps = _attr(node.attrs, "epsilon", 1e-5)
     shape = (1, -1) + (1,) * (x.ndim - 2)
     return ((x - mean.reshape(shape))
             * (scale.reshape(shape)
@@ -959,13 +972,13 @@ def _make_onnx_ops() -> Dict[str, Callable]:
         "Identity": _unary(lambda x: x),
         "Relu": _unary(jax.nn.relu),
         "LeakyRelu": lambda n, e, x: jax.nn.leaky_relu(
-            x, n.attrs.get("alpha", 0.01) or 0.01),
+            x, _attr(n.attrs, "alpha", 0.01)),
         "Elu": _unary(jax.nn.elu),
         "Selu": _unary(jax.nn.selu),
         "Sigmoid": _unary(jax.nn.sigmoid),
         "HardSigmoid": lambda n, e, x: jnp.clip(
-            (n.attrs.get("alpha", 0.2) or 0.2) * x
-            + (n.attrs.get("beta", 0.5) or 0.5), 0, 1),
+            _attr(n.attrs, "alpha", 0.2) * x
+            + _attr(n.attrs, "beta", 0.5), 0, 1),
         "Tanh": _unary(jnp.tanh),
         "Softmax": lambda n, e, x: jax.nn.softmax(
             x, axis=int(n.attrs.get("axis", -1))),

@@ -44,6 +44,10 @@ class InferenceModel:
         # concurrentNum); XLA needs no model copies.
         self.concurrent_num = concurrent_num
         self.dtype = dtype
+        from analytics_zoo_tpu.common.context import (
+            enable_compilation_cache)
+
+        enable_compilation_cache()  # serving restarts skip recompiles
         self._apply_fn: Optional[Callable] = None
         self.variables: Optional[Dict] = None
         self._compiled: Dict[Any, Callable] = {}
@@ -110,12 +114,16 @@ class InferenceModel:
         # compress them and jit treats them as runtime operands; static
         # operands (shapes/axes -- integer/scalar consts) stay baked
         # into the graph so trace-time ops see concrete values
+        import copy
+
         weights = graph_fn.weight_constants()
         self.variables = {"graph_consts": weights}
-        for name in weights:
-            # drop the fp copies from the closure so quantize() actually
-            # releases the full-precision weights
-            graph_fn.constants.pop(name)
+        # private copy without the fp weights: quantize() can release
+        # the full-precision copies, and the CALLER's GraphFunction
+        # stays intact (it must keep working standalone)
+        graph_fn = copy.copy(graph_fn)
+        graph_fn.constants = {k: v for k, v in graph_fn.constants.items()
+                              if k not in weights}
         single = len(graph_fn.input_names) == 1
 
         def apply_graph(variables, x):

@@ -42,6 +42,25 @@ from analytics_zoo_tpu.parallel.sharding import replicated
 logger = get_logger(__name__)
 
 
+def training_prng_key(seed: int):
+    """PRNG key for the training stream (dropout masks, on-device epoch
+    shuffles), with the implementation chosen by ``zoo.train.prng_impl``.
+    "auto" picks the hardware RBG generator on TPU: threefry2x32 dropout
+    mask generation costs ~23 ms/step on BERT-base (b32, L384, v5e)
+    where RBG is near-free; elsewhere auto keeps the default threefry
+    stream so CPU runs stay bit-reproducible across jax versions."""
+    impl = get_config().get("zoo.train.prng_impl")
+    if impl == "auto":
+        try:
+            on_tpu = jax.devices()[0].platform == "tpu"
+        except Exception:
+            on_tpu = False
+        impl = "rbg" if on_tpu else "threefry2x32"
+    if impl in (None, "", "threefry2x32", "default"):
+        return jax.random.PRNGKey(seed)
+    return jax.random.key(seed, impl=impl)
+
+
 def _as_dataset(data, labeled: bool = True) -> ZooDataset:
     """Coerce to ZooDataset. ``labeled=True`` splits a 2-tuple into
     (features, labels); predict paths pass ``labeled=False`` so a tuple is
@@ -156,7 +175,11 @@ class Estimator:
         self._epoch_fns: Dict[Any, Callable] = {}
         self._predict_fns: Dict[Any, Callable] = {}
         self.last_profile = None  # set by fit(profile=True)
-        self._rng = jax.random.PRNGKey(seed)
+        self._rng = training_prng_key(seed)
+        from analytics_zoo_tpu.common.context import (
+            enable_compilation_cache)
+
+        enable_compilation_cache()
 
     # ------------------------------------------------------------- setup --
     @staticmethod

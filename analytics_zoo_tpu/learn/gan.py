@@ -73,7 +73,9 @@ class GANEstimator:
         self.d_vars = None
         self.g_opt = None
         self.d_opt = None
-        self._rng = jax.random.PRNGKey(seed)
+        from analytics_zoo_tpu.learn.estimator import training_prng_key
+
+        self._rng = training_prng_key(seed)
         self._step = None
 
     # ------------------------------------------------------------ build --

@@ -72,6 +72,8 @@ class ZooModel:
     # ------------------------------------------------------- persistence --
     def save_model(self, path: str) -> None:
         """(ref: ZooModel.scala saveModel)."""
+        if self.estimator.variables is None:
+            self._build_for_load()  # fresh-model save: init then save
         os.makedirs(path, exist_ok=True)
         if jax.process_index() == 0:
             with open(os.path.join(path, "config.json"), "w") as f:

@@ -32,16 +32,19 @@ class ImageClassifier(ZooModel):
     default_metrics = ("accuracy", "top5")
 
     def __init__(self, class_num: int, backbone: str = "resnet50",
-                 image_size: int = 224):
+                 image_size: int = 224, dtype: str = "float32"):
         if backbone not in _BACKBONES:
             raise ValueError(f"unknown backbone {backbone!r}; "
                              f"known: {sorted(_BACKBONES)}")
         super().__init__(class_num=class_num, backbone=backbone,
-                         image_size=image_size)
+                         image_size=image_size, dtype=dtype)
 
     def _build_module(self):
+        import jax.numpy as jnp
+
         c = self._config
-        return _BACKBONES[c["backbone"]](num_classes=c["class_num"])
+        return _BACKBONES[c["backbone"]](num_classes=c["class_num"],
+                                         dtype=jnp.dtype(c["dtype"]))
 
     def _example_input(self):
         s = self._config["image_size"]

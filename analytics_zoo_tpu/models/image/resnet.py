@@ -19,23 +19,26 @@ class BottleneckBlock(nn.Module):
     filters: int
     strides: Tuple[int, int] = (1, 1)
     projection: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5)
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         residual = x
-        y = nn.Conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
         y = nn.relu(norm(name="bn1")(y))
         y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False,
-                    name="conv2")(y)
+                    dtype=self.dtype, name="conv2")(y)
         y = nn.relu(norm(name="bn2")(y))
         y = nn.Conv(4 * self.filters, (1, 1), use_bias=False,
-                    name="conv3")(y)
+                    dtype=self.dtype, name="conv3")(y)
         y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
         if self.projection:
             residual = nn.Conv(4 * self.filters, (1, 1), self.strides,
-                               use_bias=False, name="proj_conv")(residual)
+                               use_bias=False, dtype=self.dtype,
+                               name="proj_conv")(residual)
             residual = norm(name="proj_bn")(residual)
         return nn.relu(residual + y)
 
@@ -44,20 +47,23 @@ class BasicBlock(nn.Module):
     filters: int
     strides: Tuple[int, int] = (1, 1)
     projection: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5)
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         residual = x
         y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False,
-                    name="conv1")(x)
+                    dtype=self.dtype, name="conv1")(x)
         y = nn.relu(norm(name="bn1")(y))
-        y = nn.Conv(self.filters, (3, 3), use_bias=False, name="conv2")(y)
+        y = nn.Conv(self.filters, (3, 3), use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
         y = norm(name="bn2", scale_init=nn.initializers.zeros)(y)
         if self.projection:
             residual = nn.Conv(self.filters, (1, 1), self.strides,
-                               use_bias=False, name="proj_conv")(residual)
+                               use_bias=False, dtype=self.dtype,
+                               name="proj_conv")(residual)
             residual = norm(name="proj_bn")(residual)
         return nn.relu(residual + y)
 
@@ -69,30 +75,35 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     block: Any = BottleneckBlock
     num_filters: int = 64
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.Conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3),
-                    (3, 3)], use_bias=False, name="stem_conv")(x)
+                    (3, 3)], use_bias=False, dtype=self.dtype,
+                    name="stem_conv")(x)
         x = nn.relu(nn.BatchNorm(use_running_average=not train,
                                  momentum=0.9, epsilon=1e-5,
-                                 name="stem_bn")(x))
+                                 dtype=self.dtype, name="stem_bn")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = self.block(self.num_filters * 2 ** i, strides=strides,
-                               projection=(j == 0),
+                               projection=(j == 0), dtype=self.dtype,
                                name=f"stage{i}_block{j}")(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.num_classes, name="head")(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="head")(x)
 
 
-def ResNet18(num_classes: int = 1000) -> ResNet:
+def ResNet18(num_classes: int = 1000,
+             dtype: Any = jnp.float32) -> ResNet:
     return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes,
-                  block=BasicBlock)
+                  block=BasicBlock, dtype=dtype)
 
 
-def ResNet50(num_classes: int = 1000) -> ResNet:
+def ResNet50(num_classes: int = 1000,
+             dtype: Any = jnp.float32) -> ResNet:
     return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
-                  block=BottleneckBlock)
+                  block=BottleneckBlock, dtype=dtype)

@@ -37,3 +37,11 @@ from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
     pipeline_train_step,
 )
+from analytics_zoo_tpu.parallel.recipes import (  # noqa: F401
+    embedding_tp_spec,
+    pipeline_stage_spec,
+    transformer_tp_spec,
+)
+from analytics_zoo_tpu.parallel.staged import (  # noqa: F401
+    PipelinedTransformerLM,
+)

@@ -70,7 +70,8 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name: str,
 
 def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                    stacked_params: Any, microbatches: jnp.ndarray,
-                   mesh: Mesh, axis_name: str = "pipe") -> jnp.ndarray:
+                   mesh: Mesh, axis_name: str = "pipe",
+                   data_axis: str = None) -> jnp.ndarray:
     """Run ``stage_fn`` as an S-stage pipeline over the ``axis_name`` axis.
 
     Args:
@@ -80,18 +81,23 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         stage) -- sharded so each device gets its stage.
       microbatches: [M, *mb_shape] microbatch activations.
       mesh: mesh with a pipeline axis of size S.
+      data_axis: optional mesh axis to shard the microbatch batch dim
+        (``mb_shape[0]``) over -- a combined dp x pp mesh: each data
+        shard runs its own pipeline over the same stage parameters.
 
     Returns [M, *mb_shape]: outputs of the final stage per microbatch.
     """
     n_microbatches = microbatches.shape[0]
     param_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name), stacked_params)
+    mb_spec = (P(None, data_axis) if data_axis is not None
+               and data_axis in mesh.axis_names else P())
     fn = jax.shard_map(
         partial(_pipeline_local, stage_fn=stage_fn, axis_name=axis_name,
                 n_microbatches=n_microbatches),
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, mb_spec),
+        out_specs=mb_spec,
         check_vma=False)
     return fn(stacked_params, microbatches)
 
@@ -100,7 +106,8 @@ def pipeline_train_step(stage_fn: Callable[[Any, jnp.ndarray],
                                            jnp.ndarray],
                         loss_fn: Callable[[jnp.ndarray, jnp.ndarray],
                                           jnp.ndarray],
-                        tx, mesh: Mesh, axis_name: str = "pipe"):
+                        tx, mesh: Mesh, axis_name: str = "pipe",
+                        data_axis: str = None):
     """Build a jitted pipeline-parallel TRAINING step.
 
     The whole GPipe schedule is differentiable (``ppermute``/``scan``/
@@ -122,7 +129,7 @@ def pipeline_train_step(stage_fn: Callable[[Any, jnp.ndarray],
     def step(stacked_params, opt_state, microbatches, targets):
         def loss(params):
             out = pipeline_apply(stage_fn, params, microbatches, mesh,
-                                 axis_name)
+                                 axis_name, data_axis=data_axis)
             return loss_fn(out, targets)
 
         l, grads = jax.value_and_grad(loss)(stacked_params)

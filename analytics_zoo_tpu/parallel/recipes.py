@@ -1,0 +1,96 @@
+"""Shipped sharding recipes: megatron-style tensor parallelism for the
+transformer family.
+
+New capability relative to the reference (data-parallel only, SURVEY.md
+section 2.3). A recipe is a ``param_spec_fn`` for the Estimator: it maps
+each parameter (and, transitively, its optimizer moments -- the Estimator
+applies the same specs to ``opt_state``) to a ``PartitionSpec`` over the
+mesh's model axis. GSPMD then partitions the matmuls and inserts the
+collectives; the result is numerically exact (loss parity with the
+replicated layout), so the recipe is purely a memory/throughput knob.
+
+Layout (Megatron-LM convention):
+
+- ``qkv`` and ``ffn_in`` kernels: column-parallel (output dim sharded)
+  -- each model shard computes its slice of heads / FFN hidden;
+- ``proj`` and ``ffn_out`` kernels: row-parallel (input dim sharded)
+  -- consumes the sharded activation, XLA inserts the psum;
+- embedding tables: vocab-dim sharded;
+- LayerNorm / biases of row-parallel layers: replicated.
+
+Works for any model built on ``keras.layers.transformer`` blocks
+(TransformerModule, BERTModule and the BERT estimators), whose
+parameter names this matches by suffix.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from jax.sharding import PartitionSpec as P
+
+
+def _path_name(path) -> str:
+    return "/".join(str(getattr(k, "key", k)) for k in path).lower()
+
+
+def transformer_tp_spec(axis: str = "model",
+                        shard_embeddings: bool = True) -> Callable:
+    """``param_spec_fn`` sharding transformer blocks over ``axis``.
+
+    Pass to ``Estimator(param_spec_fn=transformer_tp_spec())`` together
+    with a mesh carrying a model axis, e.g.
+    ``create_mesh({"data": 2, "model": 4})``. Composes with data
+    parallelism (the batch shards over the data axis independently).
+    """
+
+    def spec(path, leaf) -> P:
+        name = _path_name(path)
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 2:
+            # column-parallel: output dim sharded
+            if name.endswith("qkv/kernel") or name.endswith(
+                    "ffn_in/kernel"):
+                return P(None, axis)
+            # row-parallel: input dim sharded
+            if name.endswith("proj/kernel") or name.endswith(
+                    "ffn_out/kernel"):
+                return P(axis, None)
+            if shard_embeddings and "embed" in name:
+                # vocab/position-dim sharded tables (gathers become
+                # sharded lookups + psum)
+                return P(axis, None)
+        if ndim == 1 and (name.endswith("qkv/bias")
+                          or name.endswith("ffn_in/bias")):
+            # biases of column-parallel layers follow the sharded dim
+            return P(axis)
+        return P()
+
+    return spec
+
+
+def embedding_tp_spec(axis: str = "model") -> Callable:
+    """``param_spec_fn`` sharding only embedding tables (the recommender
+    recipe: MLP stays replicated, the big tables split over ``axis``)."""
+
+    def spec(path, leaf) -> P:
+        name = _path_name(path)
+        if "embed" in name and getattr(leaf, "ndim", 0) == 2:
+            return P(axis, None)
+        return P()
+
+    return spec
+
+
+def pipeline_stage_spec(axis: str = "pipe") -> Callable:
+    """``param_spec_fn`` for stacked-stage parameters (leading dim =
+    pipeline stage, as produced by ``parallel.staged`` models)."""
+
+    def spec(path, leaf) -> P:
+        name = _path_name(path)
+        if "blocks/" in name or name.startswith("blocks"):
+            ndim = getattr(leaf, "ndim", 0)
+            return P(axis, *([None] * max(0, ndim - 1)))
+        return P()
+
+    return spec

@@ -1,0 +1,153 @@
+"""Stage-split transformer models: real models through the pipeline.
+
+New capability relative to the reference (data-parallel only, SURVEY.md
+section 2.3). ``PipelinedTransformerLM`` is an Estimator-compatible
+model (init/apply adapter contract) whose encoder blocks are the SAME
+``keras.layers.transformer.TransformerBlock`` used by TransformerModule
+and BERT -- stored stacked (leading dim = block index) so they can be
+split into pipeline stages and run through ``parallel.pipeline`` over a
+mesh ``pipe`` axis, composing with data parallelism over the ``data``
+axis (dp x pp mesh).
+
+When the active mesh has no pipe axis (or shapes don't divide), apply
+falls back to a sequential ``lax.scan`` over the stacked blocks --
+numerically identical (the pipeline only reorders the microbatch
+schedule), which is what the parity tests assert.
+
+Dropout is deterministic-off inside the pipelined encoder (same
+trade-off as ring attention: the GPipe schedule has no per-microbatch
+rng plumbing); embeddings and any head you attach stay outside the
+pipeline and may drop out freely.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from analytics_zoo_tpu.keras.layers.transformer import TransformerBlock
+from analytics_zoo_tpu.parallel.mesh import default_mesh, mesh_axis_size
+from analytics_zoo_tpu.parallel.pipeline import pipeline_apply
+
+
+class _Embedder(nn.Module):
+    """Token + position embedding (kept outside the pipeline)."""
+
+    vocab: int
+    seq_len: int
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, ids):
+        ids = ids.astype(jnp.int32)
+        tok = nn.Embed(self.vocab, self.hidden_size,
+                       name="token_embed")(ids)
+        pos = self.param("position_embed", nn.initializers.normal(0.01),
+                         (self.seq_len, self.hidden_size))
+        return tok + pos[None, :ids.shape[1]]
+
+
+class PipelinedTransformerLM:
+    """GPT-style stack with pipeline-splittable blocks.
+
+    Estimator-compatible adapter: ``init(rng, x) -> variables`` and
+    ``apply(variables, x, training, rng) -> (hidden_states, extra)``.
+    Returns the final hidden states [B, L, H] (same contract as
+    ``TransformerModule``); attach a head via the loss or wrap it.
+
+    Args:
+      n_microbatches: microbatches per step on the pipeline path; must
+        divide the (per-data-shard) batch.
+      mesh: defaults to the context mesh at call time. Pipeline engages
+        when the mesh has a ``pipe`` axis of size > 1 that divides
+        ``n_block``.
+
+    Use ``parallel.recipes.pipeline_stage_spec()`` as the Estimator's
+    ``param_spec_fn`` so each stage's block slice (and its optimizer
+    moments) lives on its pipeline rank.
+    """
+
+    def __init__(self, vocab: int, seq_len: int, hidden_size: int = 768,
+                 n_head: int = 12, n_block: int = 12,
+                 intermediate_size: Optional[int] = None,
+                 causal: bool = True, n_microbatches: int = 2,
+                 dtype: Any = jnp.float32, mesh=None):
+        self.vocab = vocab
+        self.seq_len = seq_len
+        self.hidden_size = hidden_size
+        self.n_block = n_block
+        self.n_microbatches = n_microbatches
+        self.dtype = dtype
+        self.mesh = mesh
+        self._embedder = _Embedder(vocab, seq_len, hidden_size)
+        self._block = TransformerBlock(
+            hidden_size, n_head,
+            intermediate_size or 4 * hidden_size,
+            hidden_dropout=0.0, attn_dropout=0.0, causal=causal,
+            dtype=dtype)
+
+    # ------------------------------------------------- adapter contract --
+    def init(self, rng, x) -> Dict[str, Any]:
+        ids = jnp.asarray(np.asarray(x), jnp.int32)
+        embed_vars = self._embedder.init(rng, ids)
+        h = self._embedder.apply(embed_vars, ids).astype(self.dtype)
+        block_rngs = jax.random.split(jax.random.fold_in(rng, 7),
+                                      self.n_block)
+
+        def init_block(r):
+            return self._block.init(r, h)["params"]
+
+        stacked = jax.vmap(init_block)(block_rngs)
+        return {"params": {"embed": embed_vars["params"],
+                           "blocks": stacked}}
+
+    def _mesh(self):
+        return self.mesh or default_mesh()
+
+    def apply(self, variables, x, training: bool = False, rng=None):
+        p = variables["params"]
+        ids = jnp.asarray(x)
+        h = self._embedder.apply({"params": p["embed"]}, ids)
+        h = h.astype(self.dtype)
+        blocks = p["blocks"]
+        b = h.shape[0]
+        mesh = self._mesh()
+        pipe = (mesh_axis_size(mesh, "pipe")
+                if "pipe" in mesh.axis_names else 1)
+        data = (mesh_axis_size(mesh, "data")
+                if "data" in mesh.axis_names else 1)
+        m = self.n_microbatches
+        use_pipe = (pipe > 1 and self.n_block % pipe == 0
+                    and b % m == 0 and (b // m) % data == 0)
+        if use_pipe:
+            stage_params = jax.tree_util.tree_map(
+                lambda a: a.reshape((pipe, self.n_block // pipe)
+                                    + a.shape[1:]), blocks)
+            mb = h.reshape((m, b // m) + h.shape[1:])
+
+            def stage_fn(sp, a):
+                def body(carry, layer):
+                    return self._block.apply({"params": layer},
+                                             carry), None
+
+                out, _ = lax.scan(body, a, sp)
+                return out
+
+            out = pipeline_apply(
+                stage_fn, stage_params, mb, mesh, axis_name="pipe",
+                data_axis="data" if data > 1 else None)
+            h = out.reshape((b,) + h.shape[1:])
+        else:
+            def body(carry, layer):
+                return self._block.apply({"params": layer}, carry), None
+
+            h, _ = lax.scan(body, h, blocks)
+        return h, {}
+
+    def __call__(self, variables, x):
+        return self.apply(variables, x)[0]

@@ -20,8 +20,8 @@ import os
 from typing import IO, List, Optional
 
 __all__ = ["is_remote", "open_file", "read_bytes", "write_bytes",
-           "exists", "makedirs", "listdir", "remove", "rename",
-           "get_filesystem"]
+           "exists", "makedirs", "listdir", "listdir_uris", "remove",
+           "rename", "get_filesystem"]
 
 
 def is_remote(path: str) -> bool:
@@ -93,6 +93,26 @@ def listdir(path: str) -> List[str]:
         return sorted(os.path.basename(p.rstrip("/"))
                       for p in fs.ls(_strip(path), detail=False))
     return sorted(os.listdir(path))
+
+
+def listdir_uris(path: str, kind: Optional[str] = None) -> List[str]:
+    """Full-URI entries under a remote directory, from ONE listing call.
+
+    ``ls(detail=True)`` already carries each entry's type, so filtering
+    by ``kind`` ("file" / "directory" / None for all) costs no extra
+    RPCs -- per-entry ``isfile``/``isdir`` probes would issue one
+    metadata request each, which on an object store with 10k shards
+    means 10k sequential HTTP round-trips before any data is read.
+    The scheme is re-attached so results feed straight back into this
+    module (and into fsspec-aware readers like pandas)."""
+    fs = get_filesystem(path)
+    scheme = str(path).split("://", 1)[0]
+    out = []
+    for e in fs.ls(_strip(path), detail=True):
+        if kind is not None and e.get("type") != kind:
+            continue
+        out.append(f"{scheme}://{e['name']}")
+    return sorted(out)
 
 
 def remove(path: str, recursive: bool = False) -> None:

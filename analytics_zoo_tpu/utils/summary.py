@@ -354,11 +354,11 @@ def read_events(log_dir_or_file: str) -> Dict[str, List[Tuple[int, float]]]:
 
     if fileio.is_remote(log_dir_or_file):
         fs = fileio.get_filesystem(log_dir_or_file)
-        scheme, bare = str(log_dir_or_file).split("://", 1)
+        bare = str(log_dir_or_file).split("://", 1)[1]
         if fs.isdir(bare):
-            files = sorted(f"{scheme}://{p}"
-                           for p in fs.ls(bare, detail=False)
-                           if "tfevents" in os.path.basename(p))
+            files = [u for u in fileio.listdir_uris(log_dir_or_file,
+                                                    kind="file")
+                     if "tfevents" in os.path.basename(u)]
         else:
             files = [log_dir_or_file]
     elif os.path.isdir(log_dir_or_file):

@@ -218,6 +218,41 @@ class TestONNX:
         got = np.asarray(fn(x.numpy()))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
+    def test_conv1d_parity_vs_torch(self):
+        """1-D Conv over [N, C, W] (regression: the dimension-numbers
+        spec used to be built for the wrong rank and crashed)."""
+        torch.manual_seed(2)
+        m = torch.nn.Sequential(
+            torch.nn.Conv1d(4, 8, 3, padding=1),
+            torch.nn.ReLU(),
+            torch.nn.Conv1d(8, 2, 1),
+        ).eval()
+        x = torch.randn(2, 4, 16)
+        with torch.no_grad():
+            want = m(x).numpy()
+        sd = {k: v.numpy() for k, v in m.state_dict().items()}
+        nodes = [
+            onnx_node("Conv", ["x", "0.weight", "0.bias"], ["c1"],
+                      pads=[1, 1]),
+            onnx_node("Relu", ["c1"], ["r1"]),
+            onnx_node("Conv", ["r1", "2.weight", "2.bias"], ["y"]),
+        ]
+        fn = load_onnx_model(onnx_model(nodes, sd, ["x"], ["y"]))
+        got = np.asarray(fn(x.numpy()))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_gemm_beta_zero_detaches_c(self):
+        """beta=0.0 must zero out the C term (regression: `or` default
+        coerced the explicit 0.0 back to 1.0)."""
+        a = np.ones((2, 3), np.float32)
+        b = np.ones((3, 4), np.float32)
+        c = np.full((4,), 7.0, np.float32)
+        nodes = [onnx_node("Gemm", ["a", "w", "c"], ["y"], beta=0.0)]
+        fn = load_onnx_model(onnx_model(nodes, {"w": b, "c": c},
+                                        ["a"], ["y"]))
+        got = np.asarray(fn(a))
+        np.testing.assert_allclose(got, a @ b)
+
     def test_mlp_jit_and_shape_ops(self):
         import jax
 

@@ -10,6 +10,8 @@ import sys
 
 HELPER = os.path.join(os.path.dirname(__file__), "helpers",
                       "mp_worker.py")
+PARALLEL_HELPER = os.path.join(os.path.dirname(__file__), "helpers",
+                               "mp_parallel_worker.py")
 
 
 def _free_port() -> int:
@@ -48,5 +50,41 @@ def test_two_process_fit_checkpoint_predict(tmp_path):
     results = []
     for pid in (0, 1):
         with open(tmp_path / f"result_{pid}.json") as f:
+            results.append(json.load(f))
+    assert results[0] == results[1], results
+
+
+def test_two_process_tp_sp_pp(tmp_path):
+    """tp / sp (ring attention) / pp with collectives crossing a real
+    process boundary (VERDICT r2 weak 7)."""
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, PARALLEL_HELPER, str(pid), str(port),
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"proc {pid} failed:\n{out[-4000:]}")
+        assert f"proc {pid}: OK" in out
+
+    results = []
+    for pid in (0, 1):
+        with open(tmp_path / f"par_result_{pid}.json") as f:
             results.append(json.load(f))
     assert results[0] == results[1], results

@@ -1,0 +1,184 @@
+"""Tensor/pipeline parallelism on REAL models: loss parity tests.
+
+VERDICT r2 item 4: tp and pp must be usable on real models, with
+train-step loss parity vs the single-device layout. These run the real
+SPMD code path on the 8-device CPU mesh (conftest).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from analytics_zoo_tpu.learn.estimator import Estimator
+from analytics_zoo_tpu.parallel import create_mesh
+from analytics_zoo_tpu.parallel.recipes import (
+    embedding_tp_spec, pipeline_stage_spec, transformer_tp_spec)
+from analytics_zoo_tpu.parallel.staged import PipelinedTransformerLM
+
+
+def _mesh(axes):
+    """Mesh over the first prod(sizes) devices (create_mesh insists on
+    using every device; these tests want sub-meshes)."""
+    sizes = list(axes.values())
+    n = int(np.prod(sizes))
+    devs = np.array(jax.devices()[:n]).reshape(sizes)
+    return Mesh(devs, tuple(axes))
+
+
+def _one_device_mesh():
+    return Mesh(np.array(jax.devices()[:1]), ("data",))
+
+
+def _bert_data(rng, n, seq, vocab):
+    x = rng.randint(0, vocab, (n, seq)).astype(np.int32)
+    y = np.stack([rng.randint(0, seq, n), rng.randint(0, seq, n)],
+                 axis=1).astype(np.int32)
+    return x, y
+
+
+def _fit_losses(mesh, param_spec_fn, epochs=3):
+    """Deterministic tiny BERT-SQuAD fit; returns per-epoch losses."""
+    from analytics_zoo_tpu.models.text.bert_squad import (
+        BERTForSQuAD, squad_span_loss)
+
+    rng = np.random.RandomState(0)
+    x, y = _bert_data(rng, n=8, seq=16, vocab=64)
+    module = BERTForSQuAD(vocab=64, hidden_size=32, n_block=2, n_head=2,
+                          intermediate_size=64, max_position_len=16,
+                          hidden_dropout=0.0)
+    est = Estimator(module, loss=squad_span_loss, optimizer="sgd",
+                    mesh=mesh, param_spec_fn=param_spec_fn, seed=0)
+    hist = est.fit((x, y), batch_size=8, epochs=epochs)
+    return [h["loss"] for h in hist]
+
+
+class TestTransformerTP:
+    def test_tp_spec_shapes(self):
+        """The recipe puts the megatron layout on a real BERT tree."""
+        from jax.sharding import PartitionSpec as P
+
+        from analytics_zoo_tpu.models.text.bert_squad import BERTForSQuAD
+
+        module = BERTForSQuAD(vocab=64, hidden_size=32, n_block=1,
+                              n_head=2, intermediate_size=64,
+                              max_position_len=16)
+        variables = module.init(
+            jax.random.PRNGKey(0),
+            {"input_ids": np.zeros((1, 8), np.int32)}, train=False)
+        spec = transformer_tp_spec()
+        flat = jax.tree_util.tree_flatten_with_path(
+            variables["params"])[0]
+        got = {"/".join(str(getattr(k, "key", k)) for k in p):
+               spec(p, l) for p, l in flat}
+        qkv = [k for k in got if k.endswith("qkv/kernel")]
+        proj = [k for k in got if k.endswith("proj/kernel")]
+        ffn_in = [k for k in got if k.endswith("ffn_in/kernel")]
+        ffn_out = [k for k in got if k.endswith("ffn_out/kernel")]
+        assert qkv and proj and ffn_in and ffn_out
+        for k in qkv + ffn_in:
+            assert got[k] == P(None, "model"), k
+        for k in proj + ffn_out:
+            assert got[k] == P("model", None), k
+        lns = [k for k in got if "/ln_" in k or "embed_ln" in k]
+        for k in lns:
+            assert got[k] == P(), k
+        embeds = [k for k, l in flat_lookup(flat)
+                  if "embed" in k and np.ndim(l) == 2]
+        for k in embeds:
+            assert got[k] == P("model", None), k
+
+    def test_dp_tp_loss_parity_on_bert(self):
+        """dp2 x tp2 megatron BERT == single-layout BERT, same losses."""
+        single = _fit_losses(_one_device_mesh(), None)
+        tp = _fit_losses(_mesh({"data": 2, "model": 2}),
+                         transformer_tp_spec())
+        np.testing.assert_allclose(single, tp, rtol=2e-4, atol=2e-4)
+
+    def test_tp_moments_are_sharded(self):
+        """Optimizer moments follow the param specs (sharded, not
+        replicated) -- the AllReduceParameter analog."""
+        from analytics_zoo_tpu.models.text.bert_squad import (
+            BERTForSQuAD, squad_span_loss)
+
+        mesh = _mesh({"data": 2, "model": 2})
+        rng = np.random.RandomState(0)
+        x, y = _bert_data(rng, n=4, seq=16, vocab=64)
+        module = BERTForSQuAD(vocab=64, hidden_size=32, n_block=1,
+                              n_head=2, intermediate_size=64,
+                              max_position_len=16, hidden_dropout=0.0)
+        est = Estimator(module, loss=squad_span_loss, optimizer="adam",
+                        mesh=mesh, param_spec_fn=transformer_tp_spec(),
+                        seed=0)
+        est.fit((x, y), batch_size=4, epochs=1)
+
+        def find(tree, suffix):
+            flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+            for p, leaf in flat:
+                name = "/".join(str(getattr(k, "key", k)) for k in p)
+                if name.endswith(suffix):
+                    return leaf
+            raise KeyError(suffix)
+
+        mu = est.opt_state[0].mu if hasattr(est.opt_state[0], "mu") \
+            else est.opt_state
+        leaf = find(mu, "qkv/kernel")
+        axes = {s for s in leaf.sharding.spec if s is not None}
+        assert "model" in axes, leaf.sharding
+
+
+class TestPipelinedTransformer:
+    def _data(self, n=8, seq=8, vocab=32):
+        rng = np.random.RandomState(1)
+        x = rng.randint(0, vocab, (n, seq)).astype(np.int32)
+        y = rng.randn(n, seq, 16).astype(np.float32)
+        return x, y
+
+    def _model(self, mesh):
+        return PipelinedTransformerLM(
+            vocab=32, seq_len=8, hidden_size=16, n_head=2, n_block=4,
+            intermediate_size=32, n_microbatches=2, mesh=mesh)
+
+    def test_pp_forward_matches_sequential(self):
+        x, _ = self._data()
+        seq_mesh = _one_device_mesh()
+        pp_mesh = _mesh({"pipe": 4})
+        m_seq = self._model(seq_mesh)
+        m_pp = self._model(pp_mesh)
+        variables = m_seq.init(jax.random.PRNGKey(0), x[:1])
+        ref, _ = m_seq.apply(variables, x)
+        out, _ = m_pp.apply(variables, x)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_dp_pp_train_loss_parity(self):
+        """TransformerBlock stack trained through Estimator on a
+        dp2 x pp4 mesh == the sequential single-layout run."""
+        x, y = self._data()
+
+        def run(mesh, spec_fn):
+            model = self._model(mesh)
+            est = Estimator(model, loss="mse", optimizer="sgd",
+                            mesh=mesh, param_spec_fn=spec_fn, seed=0)
+            hist = est.fit((x, y), batch_size=8, epochs=3)
+            return [h["loss"] for h in hist]
+
+        ref = run(_one_device_mesh(), None)
+        pp = run(_mesh({"data": 2, "pipe": 4}),
+                 pipeline_stage_spec())
+        np.testing.assert_allclose(ref, pp, rtol=2e-4, atol=2e-4)
+
+    def test_pp_predict_fallback(self):
+        """Non-divisible batches fall back to the sequential path."""
+        x, _ = self._data(n=3)
+        mesh = _mesh({"pipe": 4})
+        model = self._model(mesh)
+        variables = model.init(jax.random.PRNGKey(0), x[:1])
+        out, _ = model.apply(variables, x)  # 3 % 2 != 0 -> sequential
+        assert out.shape == (3, 8, 16)
+
+
+def flat_lookup(flat):
+    for p, l in flat:
+        yield "/".join(str(getattr(k, "key", k)) for k in p), l
