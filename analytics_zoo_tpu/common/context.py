@@ -32,7 +32,7 @@ from analytics_zoo_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
 
-_cache_enabled = False
+_cache_dir_applied: Optional[str] = None
 _cache_lock = threading.Lock()
 
 
@@ -42,16 +42,16 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
     paid once per machine, not once per process. Serving restarts and
     preemption-resumes then start at steady-state speed.
 
-    Idempotent; called automatically by ``init_zoo_context``, the
-    Estimator, and ``InferenceModel``. Configure with
-    ``zoo.compile_cache.dir`` ("" disables) and
-    ``zoo.compile_cache.min_compile_secs``. The dir accepts any fileio
-    URI (``gs://...`` via fsspec) -- on a pod, point every host at the
-    same bucket."""
-    global _cache_enabled
+    Idempotent per directory; called automatically by
+    ``init_zoo_context``, the Estimator, and ``InferenceModel``. A later
+    call with a DIFFERENT directory (explicit argument or a changed
+    ``zoo.compile_cache.dir``) re-points the cache -- entries compiled
+    from then on land there. Configure with ``zoo.compile_cache.dir``
+    ("" disables) and ``zoo.compile_cache.min_compile_secs``. The dir
+    accepts any fileio URI (``gs://...`` via fsspec) -- on a pod, point
+    every host at the same bucket."""
+    global _cache_dir_applied
     with _cache_lock:
-        if _cache_enabled:
-            return
         import os
 
         cfg = get_config()
@@ -59,6 +59,8 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
         if not cache_dir:
             return
         cache_dir = os.path.expanduser(str(cache_dir))
+        if cache_dir == _cache_dir_applied:
+            return
         try:
             if "://" not in cache_dir:
                 os.makedirs(cache_dir, exist_ok=True)
@@ -66,7 +68,7 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs",
                 float(cfg.get("zoo.compile_cache.min_compile_secs", 2.0)))
-            _cache_enabled = True
+            _cache_dir_applied = cache_dir
             logger.info("XLA persistent compilation cache: %s", cache_dir)
         except Exception as e:  # cache is an optimization, never fatal
             logger.warning("compilation cache unavailable: %s", e)

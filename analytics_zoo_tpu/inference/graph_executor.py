@@ -883,9 +883,8 @@ def _onnx_gemm(node, env, a, b, *maybe_c):
     import jax.numpy as jnp
 
     at = node.attrs
-    # explicit 0.0 is meaningful (beta=0 detaches C) -- no `or` coercion
-    alpha = float(at["alpha"]) if "alpha" in at else 1.0
-    beta = float(at["beta"]) if "beta" in at else 1.0
+    alpha = _attr(at, "alpha", 1.0)
+    beta = _attr(at, "beta", 1.0)
     if at.get("transA"):
         a = a.T
     if at.get("transB"):

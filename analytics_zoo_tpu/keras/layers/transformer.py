@@ -56,9 +56,14 @@ class MultiHeadSelfAttention(nn.Module):
                  train: bool = False):
         b, l, _ = x.shape
         hd = self.hidden_size // self.n_head
-        qkv = nn.Dense(3 * self.hidden_size, dtype=self.dtype,
-                       name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # fused projection with kernel [H, 3, H]: one MXU matmul, and
+        # the q/k/v sections sit on their own axis so tensor-parallel
+        # sharding of the last dim stays head-aligned (megatron layout;
+        # a flat [H, 3H] kernel puts tp shard boundaries across the
+        # q|k|v concatenation)
+        qkv = nn.DenseGeneral((3, self.hidden_size), dtype=self.dtype,
+                              name="qkv")(x)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
 
         out = None
         if (self.seq_axis is not None and mask is None

@@ -9,8 +9,9 @@ normalize-resize preprocessing).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
+import flax.linen as _nn
 import numpy as np
 
 from analytics_zoo_tpu.models.common import ZooModel, register_model
@@ -23,9 +24,36 @@ _MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
 _STD = np.asarray([0.229, 0.224, 0.225], np.float32)
 
 
+class _NormalizedBackbone(_nn.Module):
+    """Backbone wrapper: raw uint8 images normalize ON DEVICE.
+
+    Serving clients send uint8 [N, H, W, 3]; transferring those and
+    fusing /255-mean/std into the XLA program moves 4x fewer bytes
+    across the host->device link than host-side float32 preprocessing
+    (the reference normalizes on CPU before feeding the engine,
+    ref: zoo/.../feature/image/ImageChannelNormalize.scala). float
+    inputs pass through untouched (assumed already normalized); the
+    dtype test is trace-static, so each input dtype compiles its own
+    (correct) program.
+    """
+
+    backbone: Any
+
+    @_nn.compact
+    def __call__(self, x, train: bool = False):
+        import jax.numpy as jnp
+
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            x = (x.astype(jnp.float32) / 255.0
+                 - jnp.asarray(_MEAN)) / jnp.asarray(_STD)
+        return self.backbone(x, train=train)
+
+
 @register_model
 class ImageClassifier(ZooModel):
-    """Trainable classifier over a ResNet backbone."""
+    """Trainable classifier over a ResNet backbone. Accepts normalized
+    float images or raw uint8 (normalized on device -- see
+    ``_NormalizedBackbone``)."""
 
     default_loss = "sparse_categorical_crossentropy"
     default_optimizer = "adam"
@@ -43,8 +71,9 @@ class ImageClassifier(ZooModel):
         import jax.numpy as jnp
 
         c = self._config
-        return _BACKBONES[c["backbone"]](num_classes=c["class_num"],
-                                         dtype=jnp.dtype(c["dtype"]))
+        backbone = _BACKBONES[c["backbone"]](
+            num_classes=c["class_num"], dtype=jnp.dtype(c["dtype"]))
+        return _NormalizedBackbone(backbone=backbone)
 
     def _example_input(self):
         s = self._config["image_size"]

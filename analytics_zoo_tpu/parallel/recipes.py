@@ -47,10 +47,15 @@ def transformer_tp_spec(axis: str = "model",
     def spec(path, leaf) -> P:
         name = _path_name(path)
         ndim = getattr(leaf, "ndim", 0)
+        # fused qkv kernel is [H, 3, H] (DenseGeneral): shard the
+        # per-section output dim so tp slices stay head-aligned
+        if name.endswith("qkv/kernel") and ndim == 3:
+            return P(None, None, axis)
+        if name.endswith("qkv/bias") and ndim == 2:
+            return P(None, axis)
         if ndim == 2:
             # column-parallel: output dim sharded
-            if name.endswith("qkv/kernel") or name.endswith(
-                    "ffn_in/kernel"):
+            if name.endswith("ffn_in/kernel"):
                 return P(None, axis)
             # row-parallel: input dim sharded
             if name.endswith("proj/kernel") or name.endswith(
@@ -60,8 +65,7 @@ def transformer_tp_spec(axis: str = "model",
                 # vocab/position-dim sharded tables (gathers become
                 # sharded lookups + psum)
                 return P(axis, None)
-        if ndim == 1 and (name.endswith("qkv/bias")
-                          or name.endswith("ffn_in/bias")):
+        if ndim == 1 and name.endswith("ffn_in/bias"):
             # biases of column-parallel layers follow the sharded dim
             return P(axis)
         return P()

@@ -77,7 +77,9 @@ class TestTransformerTP:
         ffn_in = [k for k in got if k.endswith("ffn_in/kernel")]
         ffn_out = [k for k in got if k.endswith("ffn_out/kernel")]
         assert qkv and proj and ffn_in and ffn_out
-        for k in qkv + ffn_in:
+        for k in qkv:  # [H, 3, H] DenseGeneral kernel: head-aligned
+            assert got[k] == P(None, None, "model"), k
+        for k in ffn_in:
             assert got[k] == P(None, "model"), k
         for k in proj + ffn_out:
             assert got[k] == P("model", None), k
