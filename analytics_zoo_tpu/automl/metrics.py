@@ -74,13 +74,44 @@ def smape(y_true, y_pred):
     return float(np.mean(np.abs(y_pred - y_true) / denom) * 100)
 
 
+def accuracy(y_true, y_pred):
+    """Classification accuracy over flattened predictions (the
+    classifier counterpart of the regression metrics; the XGBoost
+    classifier model scores with this)."""
+    y_true, y_pred = _flatten(y_true, y_pred)
+    return float(np.mean(np.round(y_pred) == np.round(y_true)))
+
+
+def logloss(y_true, y_pred):
+    """Cross-entropy on PROBABILITY predictions (ref: XGBoost.py
+    classifier default metric). y_pred [N, C] class probabilities with
+    integer labels, or [N] positive-class probabilities for binary.
+    Class-id predictions are rejected: logloss on hard 0/1 ids is just
+    a scaled error rate, not the documented metric."""
+    y_pred = np.asarray(y_pred, np.float64)
+    y_true = np.asarray(y_true)
+    if y_pred.ndim == 2 and y_pred.shape[1] > 1:
+        p = np.clip(y_pred, EPSILON, 1 - EPSILON)
+        rows = np.arange(len(p))
+        return float(-np.mean(np.log(
+            p[rows, y_true.reshape(-1).astype(np.int64)])))
+    y_true, y_pred = _flatten(y_true, y_pred)
+    if y_true.max(initial=0) > 1:
+        raise ValueError("multiclass logloss needs [N, C] probability "
+                         "predictions")
+    p = np.clip(y_pred, EPSILON, 1 - EPSILON)
+    return float(-np.mean(y_true * np.log(p)
+                          + (1 - y_true) * np.log(1 - p)))
+
+
 _METRICS = {
     "me": me, "mae": mae, "mse": mse, "rmse": rmse, "msle": msle,
     "r2": r2, "mpe": mpe, "mape": mape, "smape": smape,
+    "accuracy": accuracy, "logloss": logloss,
 }
 
 # metrics where larger is better (everything else minimizes)
-MAXIMIZE = {"r2"}
+MAXIMIZE = {"r2", "accuracy"}
 
 
 def evaluate(metric: str, y_true, y_pred) -> float:

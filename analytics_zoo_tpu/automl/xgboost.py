@@ -1,0 +1,179 @@
+"""XGBoost AutoML model (ref: pyzoo/zoo/automl/model/XGBoost.py).
+
+Same hyper-parameter surface and fit_eval/predict/evaluate/save/restore
+contract as the reference's XGBRegressor/XGBClassifier wrapper. The
+engine is the real ``xgboost`` package when importable; this image
+ships none, so the default is the framework's own histogram GBT
+(``analytics_zoo_tpu.ml.gbt`` -- identical second-order training math,
+host-side: tree growth is branchy sequential work that has no business
+on the MXU).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.automl import metrics as automl_metrics
+from analytics_zoo_tpu.ml.gbt import GradientBoostedTrees
+
+_CONFIG_KEYS = ("n_estimators", "max_depth", "learning_rate",
+                "min_child_weight", "subsample", "colsample_bytree",
+                "gamma", "reg_lambda", "n_bins", "seed")
+_DEFAULTS = {"n_estimators": 100, "max_depth": 5, "learning_rate": 0.1,
+             "min_child_weight": 1.0, "subsample": 0.8,
+             "colsample_bytree": 0.8, "gamma": 0.0, "reg_lambda": 1.0,
+             "n_bins": 64, "seed": 0}
+
+
+def _have_xgboost() -> bool:
+    try:
+        import xgboost  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+class XGBoost:
+    """model_type: "regressor" or "classifier"
+    (ref: XGBoost.py model_type switch)."""
+
+    def __init__(self, model_type: str = "regressor",
+                 config: Optional[Dict[str, Any]] = None):
+        if model_type not in ("regressor", "classifier"):
+            raise ValueError(f"unknown model_type {model_type!r}")
+        self.model_type = model_type
+        self.config = dict(_DEFAULTS)
+        self.config.update({k: v for k, v in (config or {}).items()
+                            if k in _CONFIG_KEYS})
+        self.metric = (config or {}).get(
+            "metric", "rmse" if model_type == "regressor" else "accuracy")
+        self.models: list = []     # one per output column
+        self._use_xgb = _have_xgboost()
+
+    # ---------------------------------------------------------- build --
+    def _new_model(self, num_class: Optional[int] = None):
+        c = self.config
+        if self._use_xgb:
+            from xgboost.sklearn import XGBClassifier, XGBRegressor
+
+            cls = (XGBRegressor if self.model_type == "regressor"
+                   else XGBClassifier)
+            return cls(n_estimators=c["n_estimators"],
+                       max_depth=c["max_depth"],
+                       learning_rate=c["learning_rate"],
+                       min_child_weight=c["min_child_weight"],
+                       subsample=c["subsample"],
+                       colsample_bytree=c["colsample_bytree"],
+                       gamma=c["gamma"], reg_lambda=c["reg_lambda"],
+                       random_state=c["seed"], tree_method="hist")
+        if self.model_type == "regressor":
+            objective = "reg:squarederror"
+        else:
+            objective = ("binary:logistic" if (num_class or 2) <= 2
+                         else "multi:softprob")
+        return GradientBoostedTrees(
+            objective=objective,
+            num_class=(num_class if objective == "multi:softprob"
+                       else None),
+            **{k: c[k] for k in _CONFIG_KEYS if k != "n_bins"},
+            n_bins=c["n_bins"])
+
+    # ------------------------------------------------------------ fit --
+    def fit_eval(self, x: np.ndarray, y: np.ndarray,
+                 validation_data: Optional[Tuple] = None,
+                 **config) -> float:
+        """Fit and return the metric on validation (train if absent)
+        (ref: XGBoost.fit_eval)."""
+        self.config.update({k: v for k, v in config.items()
+                            if k in _CONFIG_KEYS})
+        self.metric = config.get("metric", self.metric)
+        x = np.asarray(x, np.float32).reshape(len(x), -1)
+        y = np.asarray(y)
+        y2 = y.reshape(len(y), -1)
+        self.models = []
+        for j in range(y2.shape[1]):
+            col = y2[:, j]
+            num_class = (int(col.max()) + 1
+                         if self.model_type == "classifier" else None)
+            m = self._new_model(num_class=num_class)
+            m.fit(x, col)
+            self.models.append(m)
+        vx, vy = (x, y2) if validation_data is None else (
+            np.asarray(validation_data[0], np.float32).reshape(
+                len(validation_data[0]), -1),
+            np.asarray(validation_data[1]).reshape(
+                len(validation_data[1]), -1))
+        if (self.metric == "logloss"
+                and self.model_type == "classifier"):
+            # logloss is defined on probabilities, not class ids
+            if vy.shape[1] != 1:
+                raise ValueError("logloss scoring supports a single "
+                                 "label column")
+            return automl_metrics.evaluate(
+                "logloss", vy[:, 0], self.predict_proba(vx))
+        pred = self.predict(vx)
+        return automl_metrics.evaluate(self.metric, vy, pred)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        if not self.models:
+            raise RuntimeError("model not fitted")
+        x = np.asarray(x, np.float32).reshape(len(x), -1)
+        cols = [np.asarray(m.predict(x)).reshape(-1)
+                for m in self.models]
+        return np.stack(cols, axis=1)
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        if self.model_type != "classifier":
+            raise ValueError("predict_proba needs model_type=classifier")
+        x = np.asarray(x, np.float32).reshape(len(x), -1)
+        return np.asarray(self.models[0].predict_proba(x))
+
+    def evaluate(self, x, y, metrics=("mse",)) -> Dict[str, float]:
+        y2 = np.asarray(y).reshape(len(y), -1)
+        pred = self.predict(np.asarray(x, np.float32))
+        return automl_metrics.evaluate_all(metrics, y2, pred)
+
+    # ----------------------------------------------------- persistence --
+    def save(self, dir_path: str) -> None:
+        os.makedirs(dir_path, exist_ok=True)
+        meta = {"model_type": self.model_type, "config": self.config,
+                "metric": self.metric, "engine":
+                ("xgboost" if self._use_xgb else "gbt"),
+                "n_outputs": len(self.models)}
+        with open(os.path.join(dir_path, "xgb.json"), "w") as f:
+            json.dump(meta, f)
+        for j, m in enumerate(self.models):
+            path = os.path.join(dir_path, f"model_{j}")
+            if self._use_xgb:
+                m.save_model(path + ".ubj")
+            else:
+                m.save(path + ".json")
+
+    @classmethod
+    def restore(cls, dir_path: str) -> "XGBoost":
+        with open(os.path.join(dir_path, "xgb.json")) as f:
+            meta = json.load(f)
+        model = cls(model_type=meta["model_type"],
+                    config=dict(meta["config"], metric=meta["metric"]))
+        if meta["engine"] == "xgboost" and not model._use_xgb:
+            raise RuntimeError(
+                "checkpoint was written by the real xgboost engine, "
+                "which is not importable here")
+        model.models = []
+        for j in range(meta["n_outputs"]):
+            path = os.path.join(dir_path, f"model_{j}")
+            if meta["engine"] == "xgboost":
+                from xgboost.sklearn import XGBClassifier, XGBRegressor
+
+                m = (XGBRegressor() if meta["model_type"] == "regressor"
+                     else XGBClassifier())
+                m.load_model(path + ".ubj")
+            else:
+                m = GradientBoostedTrees.load(path + ".json")
+            model.models.append(m)
+        return model

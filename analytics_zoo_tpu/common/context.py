@@ -64,6 +64,13 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
         try:
             if "://" not in cache_dir:
                 os.makedirs(cache_dir, exist_ok=True)
+            if _cache_dir_applied is not None:
+                # jax memoizes the cache object at first use; re-pointing
+                # the dir requires dropping it or the update is silent
+                from jax.experimental.compilation_cache import (
+                    compilation_cache)
+
+                compilation_cache.reset_cache()
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs",

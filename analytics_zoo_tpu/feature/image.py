@@ -22,13 +22,21 @@ import numpy as np
 
 
 class ImageFeature:
-    """One image record (ref: ImageFeature keys image/label/uri)."""
+    """One image record (ref: ImageFeature keys image/label/uri;
+    ``bboxes``/``bbox_labels`` mirror the detection keys the reference's
+    RoiImageFeature carries through its augmentation chain)."""
 
     def __init__(self, image: np.ndarray, label: Optional[int] = None,
-                 uri: Optional[str] = None):
+                 uri: Optional[str] = None,
+                 bboxes: Optional[np.ndarray] = None,
+                 bbox_labels: Optional[np.ndarray] = None):
         self.image = np.asarray(image, np.float32)
         self.label = label
         self.uri = uri
+        self.bboxes = (None if bboxes is None
+                       else np.asarray(bboxes, np.float32).reshape(-1, 4))
+        self.bbox_labels = (None if bbox_labels is None
+                            else np.asarray(bbox_labels, np.int32))
         self.sample: Optional[np.ndarray] = None
 
 
@@ -48,10 +56,21 @@ class ImageProcessing:
 
 
 class ImageResize(ImageProcessing):
-    """Bilinear resize to (h, w) (ref: ImageResize.scala)."""
+    """Bilinear resize to (h, w); bboxes scale along
+    (ref: ImageResize.scala)."""
 
     def __init__(self, resize_h: int, resize_w: int):
         self.resize_h, self.resize_w = resize_h, resize_w
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        h, w = feature.image.shape[:2]
+        feature.image = self.apply_image(feature.image)
+        if feature.bboxes is not None:
+            b = feature.bboxes.copy()
+            b[:, 0::2] *= self.resize_w / w
+            b[:, 1::2] *= self.resize_h / h
+            feature.bboxes = b
+        return feature
 
     def apply_image(self, img):
         from PIL import Image
@@ -66,36 +85,84 @@ class ImageResize(ImageProcessing):
         return np.stack(chans, axis=-1)
 
 
+def _crop_bboxes(feature: "ImageFeature", top: int, left: int,
+                 crop_h: int, crop_w: int) -> None:
+    """Shift bboxes into the crop frame, clip to it, and drop boxes
+    (plus their labels) that fell entirely outside -- cropping with
+    stale pre-crop coordinates would silently corrupt detection
+    targets."""
+    if feature.bboxes is None:
+        return
+    b = feature.bboxes.copy()
+    b[:, 0::2] = np.clip(b[:, 0::2] - left, 0, crop_w)
+    b[:, 1::2] = np.clip(b[:, 1::2] - top, 0, crop_h)
+    keep = (b[:, 2] > b[:, 0]) & (b[:, 3] > b[:, 1])
+    feature.bboxes = b[keep]
+    if feature.bbox_labels is not None:
+        feature.bbox_labels = feature.bbox_labels[keep]
+
+
 class ImageCenterCrop(ImageProcessing):
-    """Crop (crop_h, crop_w) from the center (ref: ImageCenterCrop.scala)."""
+    """Crop (crop_h, crop_w) from the center; bboxes shift/clip/drop
+    with the crop (ref: ImageCenterCrop.scala)."""
 
     def __init__(self, crop_h: int, crop_w: int):
         self.crop_h, self.crop_w = crop_h, crop_w
 
-    def apply_image(self, img):
+    def _offsets(self, img) -> Tuple[int, int]:
         h, w = img.shape[:2]
-        top = max(0, (h - self.crop_h) // 2)
-        left = max(0, (w - self.crop_w) // 2)
+        return (max(0, (h - self.crop_h) // 2),
+                max(0, (w - self.crop_w) // 2))
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        top, left = self._offsets(feature.image)
+        feature.image = feature.image[top:top + self.crop_h,
+                                      left:left + self.crop_w]
+        _crop_bboxes(feature, top, left, self.crop_h, self.crop_w)
+        return feature
+
+    def apply_image(self, img):
+        top, left = self._offsets(img)
         return img[top:top + self.crop_h, left:left + self.crop_w]
 
 
 class ImageRandomCrop(ImageProcessing):
-    """Crop (crop_h, crop_w) at a uniform random offset
-    (ref: ImageRandomCrop.scala)."""
+    """Crop (crop_h, crop_w) at a uniform random offset; bboxes
+    shift/clip/drop with the crop (ref: ImageRandomCrop.scala)."""
 
     def __init__(self, crop_h: int, crop_w: int, seed: Optional[int] = None):
         self.crop_h, self.crop_w = crop_h, crop_w
         self._rng = np.random.RandomState(seed)
 
-    def apply_image(self, img):
+    def _offsets(self, img) -> Tuple[int, int]:
         h, w = img.shape[:2]
-        top = self._rng.randint(0, max(1, h - self.crop_h + 1))
-        left = self._rng.randint(0, max(1, w - self.crop_w + 1))
+        return (self._rng.randint(0, max(1, h - self.crop_h + 1)),
+                self._rng.randint(0, max(1, w - self.crop_w + 1)))
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        top, left = self._offsets(feature.image)
+        feature.image = feature.image[top:top + self.crop_h,
+                                      left:left + self.crop_w]
+        _crop_bboxes(feature, top, left, self.crop_h, self.crop_w)
+        return feature
+
+    def apply_image(self, img):
+        top, left = self._offsets(img)
         return img[top:top + self.crop_h, left:left + self.crop_w]
 
 
 class ImageHFlip(ImageProcessing):
-    """Horizontal mirror (ref: ImageHFlip.scala)."""
+    """Horizontal mirror; bboxes mirror with it (ref: ImageHFlip.scala)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        w = feature.image.shape[1]
+        feature.image = self.apply_image(feature.image)
+        if feature.bboxes is not None:
+            b = feature.bboxes.copy()
+            b[:, 0], b[:, 2] = w - feature.bboxes[:, 2], \
+                w - feature.bboxes[:, 0]
+            feature.bboxes = b
+        return feature
 
     def apply_image(self, img):
         return img[:, ::-1]
@@ -253,6 +320,160 @@ class ImageRandomPreprocessing(ImageProcessing):
         if self._rng.uniform() < self.prob:
             return self.op.apply_image(img)
         return img
+
+
+class ImageExpand(ImageProcessing):
+    """Zoom-out augmentation: place the image on a mean-filled canvas
+    expanded by a random ratio in [1, max_expand_ratio], shifting any
+    bboxes with it (ref: zoo/.../feature/image/ImageExpand -> BigDL
+    Expand op -- the SSD small-object augmentation)."""
+
+    def __init__(self, max_expand_ratio: float = 4.0,
+                 means: Sequence[float] = (123.0, 117.0, 104.0),
+                 seed: Optional[int] = None):
+        self.max_expand_ratio = max_expand_ratio
+        self.means = np.asarray(means, np.float32)
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        img = feature.image
+        h, w = img.shape[:2]
+        ratio = self._rng.uniform(1.0, self.max_expand_ratio)
+        nh, nw = int(h * ratio), int(w * ratio)
+        top = self._rng.randint(0, nh - h + 1)
+        left = self._rng.randint(0, nw - w + 1)
+        canvas = np.broadcast_to(
+            self.means[:img.shape[-1]],
+            (nh, nw, img.shape[-1])).astype(np.float32).copy()
+        canvas[top:top + h, left:left + w] = img
+        feature.image = canvas
+        if feature.bboxes is not None:
+            b = feature.bboxes.copy()
+            b[:, 0::2] += left
+            b[:, 1::2] += top
+            feature.bboxes = b
+        return feature
+
+    def apply_image(self, img):
+        return self.transform(ImageFeature(img)).image
+
+
+class ImageFiller(ImageProcessing):
+    """Fill a normalized-coordinate region with a constant value
+    (ref: zoo/.../feature/image/ImageFiller -> BigDL Filler -- used to
+    black out regions, e.g. license plates)."""
+
+    def __init__(self, start_x: float, start_y: float, end_x: float,
+                 end_y: float, value: float = 255.0):
+        self.start_x, self.start_y = start_x, start_y
+        self.end_x, self.end_y = end_x, end_y
+        self.value = value
+
+    def apply_image(self, img):
+        h, w = img.shape[:2]
+        out = img.copy()
+        x1 = int(np.clip(self.start_x * w, 0, w))
+        x2 = int(np.clip(self.end_x * w, 0, w))
+        y1 = int(np.clip(self.start_y * h, 0, h))
+        y2 = int(np.clip(self.end_y * h, 0, h))
+        out[y1:y2, x1:x2] = self.value
+        return out
+
+
+class ImageAspectScale(ImageProcessing):
+    """Aspect-preserving resize: shorter side to ``min_size``, longer
+    side capped at ``max_size``, optionally rounded to a multiple
+    (ref: zoo/.../feature/image/ImageAspectScale -> BigDL AspectScale,
+    the Faster-RCNN input scaling); bboxes scale along."""
+
+    def __init__(self, min_size: int, max_size: int = 1000,
+                 scale_multiple_of: int = 1):
+        self.min_size = min_size
+        self.max_size = max_size
+        self.scale_multiple_of = scale_multiple_of
+
+    def _scale_for(self, h: int, w: int) -> float:
+        short, long = min(h, w), max(h, w)
+        scale = self.min_size / short
+        if scale * long > self.max_size:
+            scale = self.max_size / long
+        return scale
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        h, w = feature.image.shape[:2]
+        scale = self._scale_for(h, w)
+        nh, nw = int(round(h * scale)), int(round(w * scale))
+        m = self.scale_multiple_of
+        if m > 1:
+            nh, nw = -(-nh // m) * m, -(-nw // m) * m
+        # delegate: ImageResize owns the image+bbox rescale logic
+        return ImageResize(nh, nw).transform(feature)
+
+    def apply_image(self, img):
+        return self.transform(ImageFeature(img)).image
+
+
+class ImageRandomAspectScale(ImageProcessing):
+    """AspectScale with the short-side target drawn from ``min_sizes``
+    (ref: zoo/.../feature/image/ImageRandomAspectScale)."""
+
+    def __init__(self, min_sizes: Sequence[int], max_size: int = 1000,
+                 scale_multiple_of: int = 1, seed: Optional[int] = None):
+        self.min_sizes = list(min_sizes)
+        self.max_size = max_size
+        self.scale_multiple_of = scale_multiple_of
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        size = self.min_sizes[self._rng.randint(len(self.min_sizes))]
+        return ImageAspectScale(size, self.max_size,
+                                self.scale_multiple_of).transform(feature)
+
+    def apply_image(self, img):
+        return self.transform(ImageFeature(img)).image
+
+
+class ImageColorJitter(ImageProcessing):
+    """Random brightness/contrast/saturation in random order
+    (ref: zoo/.../feature/image/ImageColorJitter -> BigDL ColorJitter,
+    the SSD photometric-distortion chain)."""
+
+    def __init__(self, brightness_delta: float = 32.0,
+                 contrast_range: Tuple[float, float] = (0.5, 1.5),
+                 saturation_range: Tuple[float, float] = (0.5, 1.5),
+                 seed: Optional[int] = None):
+        self.brightness_delta = brightness_delta
+        self.contrast_range = contrast_range
+        self.saturation_range = saturation_range
+        self._rng = np.random.RandomState(seed)
+
+    def apply_image(self, img):
+        ops = [self._brightness, self._contrast, self._saturation]
+        for i in self._rng.permutation(len(ops)):
+            img = ops[i](img)
+        return img
+
+    def _brightness(self, img):
+        delta = self._rng.uniform(-self.brightness_delta,
+                                  self.brightness_delta)
+        return np.clip(img + delta, 0.0, 255.0)
+
+    def _contrast(self, img):
+        f = self._rng.uniform(*self.contrast_range)
+        mean = img.mean()
+        return np.clip((img - mean) * f + mean, 0.0, 255.0)
+
+    def _saturation(self, img):
+        if img.shape[-1] != 3:
+            return img
+        f = self._rng.uniform(*self.saturation_range)
+        gray = img.mean(axis=-1, keepdims=True)
+        return np.clip((img - gray) * f + gray, 0.0, 255.0)
+
+
+# the reference wraps ops in RandomTransformer(op, prob); identical
+# semantics to ImageRandomPreprocessing (ref: RandomTransformer.scala)
+ImageRandomTransformer = ImageRandomPreprocessing
 
 
 class ChainedImageProcessing(ImageProcessing):

@@ -50,6 +50,66 @@ def import_torch_state_dict(state_dict, key_map: Optional[Dict[str, str]]
     return out
 
 
+def import_torch_bert(state_dict) -> Dict:
+    """HuggingFace-layout torch BERT encoder -> ``BERTModule`` params.
+
+    Structural transforms beyond key renames (which is why the generic
+    ``import_torch_state_dict`` cannot do this): the separate
+    query/key/value linears stack into the fused [H, 3, H] qkv kernel,
+    attention.output/intermediate/output map onto proj/ffn_in/ffn_out,
+    and the embedding tables land on token/position/segment_embed.
+    Accepts a ``BertModel.state_dict()`` (or ``bert.``-prefixed keys
+    from a task model). End-to-end golden: logits parity vs torch in
+    ``tests/test_bert_golden.py`` (the KerasRunner pattern,
+    ref: zoo/src/test/.../KerasRunner.scala:40-120).
+    """
+    sd = {}
+    for k, v in state_dict.items():
+        arr = np.asarray(v.detach().cpu().numpy()
+                         if hasattr(v, "detach") else v)
+        sd[k[5:] if k.startswith("bert.") else k] = arr
+
+    def lin(prefix):
+        return {"kernel": sd[prefix + ".weight"].T,
+                "bias": sd[prefix + ".bias"]}
+
+    def ln(prefix):
+        return {"scale": sd[prefix + ".weight"],
+                "bias": sd[prefix + ".bias"]}
+
+    params: Dict = {
+        "token_embed": {
+            "embedding": sd["embeddings.word_embeddings.weight"]},
+        "position_embed": sd["embeddings.position_embeddings.weight"],
+        "segment_embed": {
+            "embedding": sd["embeddings.token_type_embeddings.weight"]},
+        "embed_ln": ln("embeddings.LayerNorm"),
+    }
+    n_layers = 1 + max(
+        int(k.split(".")[2]) for k in sd if k.startswith("encoder.layer."))
+    for i in range(n_layers):
+        p = f"encoder.layer.{i}"
+        qkv_kernel = np.stack(
+            [sd[f"{p}.attention.self.{n}.weight"].T
+             for n in ("query", "key", "value")], axis=1)  # [H, 3, H]
+        qkv_bias = np.stack(
+            [sd[f"{p}.attention.self.{n}.bias"]
+             for n in ("query", "key", "value")], axis=0)  # [3, H]
+        params[f"encoder_{i}"] = {
+            "attention": {
+                "qkv": {"kernel": qkv_kernel, "bias": qkv_bias},
+                "proj": lin(f"{p}.attention.output.dense"),
+            },
+            "ln_attn": ln(f"{p}.attention.output.LayerNorm"),
+            "ffn_in": lin(f"{p}.intermediate.dense"),
+            "ffn_out": lin(f"{p}.output.dense"),
+            "ln_ffn": ln(f"{p}.output.LayerNorm"),
+        }
+    if "pooler.dense.weight" in sd:
+        params["pooler"] = lin("pooler.dense")
+    return params
+
+
 _TF_RENAMES = {"gamma": "scale", "beta": "bias", "moving_mean": "mean",
                "moving_variance": "var"}
 

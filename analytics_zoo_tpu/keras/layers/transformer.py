@@ -122,13 +122,17 @@ class TransformerBlock(nn.Module):
     attn_dropout: float = 0.1
     causal: bool = False
     activation: str = "gelu"
+    ln_eps: float = 1e-5
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None, key_padding_mask=None,
                  train: bool = False):
-        act = jax.nn.gelu if self.activation == "gelu" else jax.nn.relu
+        # exact (erf) gelu: matches the reference BERT/torch numerics;
+        # jax's default tanh approximation diverges ~1e-3
+        act = ((lambda t: jax.nn.gelu(t, approximate=False))
+               if self.activation == "gelu" else jax.nn.relu)
         attn = MultiHeadSelfAttention(
             self.hidden_size, self.n_head, attn_dropout=self.attn_dropout,
             causal=self.causal, dtype=self.dtype,
@@ -137,7 +141,7 @@ class TransformerBlock(nn.Module):
                 train=train)
         attn = nn.Dropout(self.hidden_dropout,
                           deterministic=not train)(attn)
-        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
                          name="ln_attn")(x + attn)
         h = nn.Dense(self.intermediate_size, dtype=self.dtype,
                      name="ffn_in")(x)
@@ -145,7 +149,7 @@ class TransformerBlock(nn.Module):
         h = nn.Dense(self.hidden_size, dtype=self.dtype,
                      name="ffn_out")(h)
         h = nn.Dropout(self.hidden_dropout, deterministic=not train)(h)
-        return nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
+        return nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
                             name="ln_ffn")(x + h)
 
 
@@ -235,7 +239,7 @@ class BERTModule(nn.Module):
                 self.hidden_size, self.n_head, self.intermediate_size,
                 hidden_dropout=self.hidden_dropout,
                 attn_dropout=self.attn_dropout, causal=False,
-                dtype=self.dtype, seq_axis=self.seq_axis,
+                ln_eps=1e-12, dtype=self.dtype, seq_axis=self.seq_axis,
                 name=f"encoder_{i}")(h, key_padding_mask=attn_mask,
                                      train=train)
         pooled = jnp.tanh(nn.Dense(self.hidden_size, name="pooler")

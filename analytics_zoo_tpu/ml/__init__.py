@@ -1,0 +1,7 @@
+"""Classical-ML utilities (host-side): gradient-boosted trees."""
+
+from analytics_zoo_tpu.ml.gbt import (  # noqa: F401
+    GBTClassifier,
+    GBTRegressor,
+    GradientBoostedTrees,
+)

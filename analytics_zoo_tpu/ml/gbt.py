@@ -1,0 +1,310 @@
+"""Histogram-based gradient-boosted trees (xgboost-style).
+
+The reference integrates XGBoost twice -- as an AutoML model
+(ref: pyzoo/zoo/automl/model/XGBoost.py wrapping XGBRegressor/
+XGBClassifier) and as a Spark-ML helper
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/pipeline/nnframes/
+XGBoostHelper.scala). This image ships no xgboost wheel, so the
+framework carries its own engine with the same training math
+(second-order boosting: gain = 1/2 [G_L^2/(H_L+l) + G_R^2/(H_R+l) -
+G^2/(H+l)] - gamma, leaf weight -G/(H+l)) behind an xgboost-compatible
+parameter surface; callers (automl.xgboost, nnframes.xgb) prefer the
+real ``xgboost`` package when importable and fall back here.
+
+Trees are host-side numpy -- boosting is sequential and branchy, the
+one workload class the MXU does not want; inference over the fitted
+ensemble is vectorized numpy as well.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["GradientBoostedTrees", "GBTRegressor", "GBTClassifier"]
+
+
+class _Tree:
+    """Flat-array binary tree: internal nodes carry (feature, bin
+    threshold); leaves carry weights."""
+
+    __slots__ = ("feature", "threshold", "left", "right", "value")
+
+    def __init__(self):
+        self.feature: List[int] = []
+        self.threshold: List[float] = []
+        self.left: List[int] = []
+        self.right: List[int] = []
+        self.value: List[float] = []
+
+    def add(self, feature=-1, threshold=0.0, value=0.0) -> int:
+        self.feature.append(feature)
+        self.threshold.append(threshold)
+        self.left.append(-1)
+        self.right.append(-1)
+        self.value.append(value)
+        return len(self.feature) - 1
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        feature = np.asarray(self.feature)
+        thresh = np.asarray(self.threshold, np.float32)
+        left = np.asarray(self.left)
+        right = np.asarray(self.right)
+        value = np.asarray(self.value, np.float32)
+        idx = np.zeros(len(x), np.int64)
+        # levels are bounded by max_depth; loop until every row parked
+        # on a leaf (feature == -1)
+        while True:
+            at_leaf = feature[idx] < 0
+            if at_leaf.all():
+                return value[idx]
+            go_left = x[np.arange(len(x)), np.maximum(feature[idx], 0)] \
+                <= thresh[idx]
+            nxt = np.where(go_left, left[idx], right[idx])
+            idx = np.where(at_leaf, idx, nxt)
+
+    def to_dict(self) -> Dict[str, list]:
+        return {"feature": [int(v) for v in self.feature],
+                "threshold": [float(v) for v in self.threshold],
+                "left": [int(v) for v in self.left],
+                "right": [int(v) for v in self.right],
+                "value": [float(v) for v in self.value]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, list]) -> "_Tree":
+        t = cls()
+        t.feature = list(d["feature"])
+        t.threshold = [float(v) for v in d["threshold"]]
+        t.left = list(d["left"])
+        t.right = list(d["right"])
+        t.value = [float(v) for v in d["value"]]
+        return t
+
+
+class GradientBoostedTrees:
+    """Second-order boosting with quantile-binned histogram splits.
+
+    Parameters mirror xgboost: ``n_estimators``, ``max_depth``,
+    ``learning_rate``, ``reg_lambda``, ``gamma`` (min split gain),
+    ``min_child_weight``, ``subsample``, ``colsample_bytree``,
+    ``n_bins``. ``objective``: "reg:squarederror", "binary:logistic" or
+    "multi:softprob" (set ``num_class``).
+    """
+
+    def __init__(self, objective: str = "reg:squarederror",
+                 n_estimators: int = 50, max_depth: int = 4,
+                 learning_rate: float = 0.2, reg_lambda: float = 1.0,
+                 gamma: float = 0.0, min_child_weight: float = 1.0,
+                 subsample: float = 1.0, colsample_bytree: float = 1.0,
+                 n_bins: int = 64, num_class: Optional[int] = None,
+                 seed: int = 0):
+        self.objective = objective
+        self.n_estimators = int(n_estimators)
+        self.max_depth = int(max_depth)
+        self.learning_rate = float(learning_rate)
+        self.reg_lambda = float(reg_lambda)
+        self.gamma = float(gamma)
+        self.min_child_weight = float(min_child_weight)
+        self.subsample = float(subsample)
+        self.colsample_bytree = float(colsample_bytree)
+        self.n_bins = int(n_bins)
+        self.num_class = num_class
+        self.seed = seed
+        self.trees_: List[List[_Tree]] = []   # [round][output]
+        self.base_score_: Optional[np.ndarray] = None
+        self._bin_edges: Optional[List[np.ndarray]] = None
+
+    # ------------------------------------------------------- internals --
+    def _n_outputs(self) -> int:
+        if self.objective == "multi:softprob":
+            if not self.num_class or self.num_class < 2:
+                raise ValueError("multi:softprob needs num_class >= 2")
+            return int(self.num_class)
+        return 1
+
+    def _grad_hess(self, y: np.ndarray, margin: np.ndarray):
+        if self.objective == "reg:squarederror":
+            return margin - y[:, None], np.ones_like(margin)
+        if self.objective == "binary:logistic":
+            p = 1.0 / (1.0 + np.exp(-margin))
+            return p - y[:, None], np.maximum(p * (1 - p), 1e-6)
+        if self.objective == "multi:softprob":
+            m = margin - margin.max(axis=1, keepdims=True)
+            e = np.exp(m)
+            p = e / e.sum(axis=1, keepdims=True)
+            onehot = np.eye(self._n_outputs(), dtype=np.float32)[
+                y.astype(np.int64)]
+            return p - onehot, np.maximum(p * (1 - p), 1e-6)
+        raise ValueError(f"unknown objective {self.objective!r}")
+
+    def _bin(self, x: np.ndarray):
+        """Quantile bin edges per feature; returns binned uint16 codes."""
+        edges = []
+        codes = np.empty(x.shape, np.uint16)
+        qs = np.linspace(0, 100, self.n_bins + 1)[1:-1]
+        for j in range(x.shape[1]):
+            e = np.unique(np.percentile(x[:, j], qs))
+            edges.append(e.astype(np.float32))
+            codes[:, j] = np.searchsorted(e, x[:, j], side="left")
+        self._bin_edges = edges
+        return codes
+
+    def _build_tree(self, codes, x, grad, hess, rows, cols) -> _Tree:
+        tree = _Tree()
+
+        def grow(node_rows, depth) -> int:
+            g, h = grad[node_rows].sum(), hess[node_rows].sum()
+            if depth >= self.max_depth or len(node_rows) < 2:
+                return tree.add(value=float(
+                    -g / (h + self.reg_lambda) * self.learning_rate))
+            best = None
+            for j in cols:
+                nb = len(self._bin_edges[j]) + 1
+                if nb < 2:
+                    continue
+                c = codes[node_rows, j]
+                gh = np.zeros((nb, 2), np.float64)
+                np.add.at(gh, c, np.stack(
+                    [grad[node_rows], hess[node_rows]], axis=1))
+                gl = np.cumsum(gh[:-1, 0])
+                hl = np.cumsum(gh[:-1, 1])
+                gr, hr = g - gl, h - hl
+                ok = (np.minimum(hl, hr) >= self.min_child_weight)
+                gain = 0.5 * (gl ** 2 / (hl + self.reg_lambda)
+                              + gr ** 2 / (hr + self.reg_lambda)
+                              - g ** 2 / (h + self.reg_lambda)) \
+                    - self.gamma
+                gain = np.where(ok, gain, -np.inf)
+                b = int(np.argmax(gain))
+                if gain[b] > 0 and (best is None or gain[b] > best[0]):
+                    best = (float(gain[b]), j, b)
+            if best is None:
+                return tree.add(value=float(
+                    -g / (h + self.reg_lambda) * self.learning_rate))
+            _, j, b = best
+            node = tree.add(feature=j,
+                            threshold=float(self._bin_edges[j][b])
+                            if b < len(self._bin_edges[j])
+                            else float("inf"))
+            go_left = codes[node_rows, j] <= b
+            tree.left[node] = grow(node_rows[go_left], depth + 1)
+            tree.right[node] = grow(node_rows[~go_left], depth + 1)
+            return node
+
+        grow(rows, 0)
+        return tree
+
+    # --------------------------------------------------------- fitting --
+    def fit(self, x: np.ndarray, y: np.ndarray
+            ) -> "GradientBoostedTrees":
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y, np.float32).reshape(len(x))
+        k = self._n_outputs()
+        rng = np.random.RandomState(self.seed)
+        codes = self._bin(x)
+        if self.objective == "reg:squarederror":
+            self.base_score_ = np.asarray([float(y.mean())] * k,
+                                          np.float32)
+        else:
+            self.base_score_ = np.zeros((k,), np.float32)
+        margin = np.broadcast_to(self.base_score_,
+                                 (len(x), k)).astype(np.float64).copy()
+        self.trees_ = []
+        n_cols = max(1, int(round(self.colsample_bytree * x.shape[1])))
+        n_rows = max(2, int(round(self.subsample * len(x))))
+        for _ in range(self.n_estimators):
+            grad, hess = self._grad_hess(y, margin)
+            round_trees: List[_Tree] = []
+            for out in range(k):
+                rows = (np.arange(len(x)) if n_rows >= len(x) else
+                        rng.choice(len(x), n_rows, replace=False))
+                cols = (np.arange(x.shape[1]) if n_cols >= x.shape[1]
+                        else np.sort(rng.choice(x.shape[1], n_cols,
+                                                replace=False)))
+                tree = self._build_tree(codes, x, grad[:, out],
+                                        hess[:, out], rows, cols)
+                margin[:, out] += tree.predict(x)
+                round_trees.append(tree)
+            self.trees_.append(round_trees)
+        return self
+
+    # ------------------------------------------------------- inference --
+    def margin(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        k = self._n_outputs()
+        out = np.broadcast_to(self.base_score_,
+                              (len(x), k)).astype(np.float64).copy()
+        for round_trees in self.trees_:
+            for j, tree in enumerate(round_trees):
+                out[:, j] += tree.predict(x)
+        return out
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        m = self.margin(x)
+        if self.objective == "reg:squarederror":
+            return m[:, 0].astype(np.float32)
+        if self.objective == "binary:logistic":
+            return (m[:, 0] > 0).astype(np.int32)
+        return m.argmax(axis=1).astype(np.int32)
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        m = self.margin(x)
+        if self.objective == "binary:logistic":
+            p = 1.0 / (1.0 + np.exp(-m[:, 0]))
+            return np.stack([1 - p, p], axis=1).astype(np.float32)
+        if self.objective == "multi:softprob":
+            m = m - m.max(axis=1, keepdims=True)
+            e = np.exp(m)
+            return (e / e.sum(axis=1, keepdims=True)).astype(np.float32)
+        raise ValueError("predict_proba needs a classification objective")
+
+    # ----------------------------------------------------- persistence --
+    def save(self, path: str) -> None:
+        meta = {k: getattr(self, k) for k in (
+            "objective", "n_estimators", "max_depth", "learning_rate",
+            "reg_lambda", "gamma", "min_child_weight", "subsample",
+            "colsample_bytree", "n_bins", "num_class", "seed")}
+        blob = {
+            "meta": meta,
+            "base_score": (None if self.base_score_ is None
+                           else self.base_score_.tolist()),
+            "bin_edges": (None if self._bin_edges is None
+                          else [e.tolist() for e in self._bin_edges]),
+            "trees": [[t.to_dict() for t in r] for r in self.trees_],
+        }
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(blob, f)
+
+    @classmethod
+    def load(cls, path: str) -> "GradientBoostedTrees":
+        with open(path) as f:
+            blob = json.load(f)
+        model = cls(**blob["meta"])
+        if blob["base_score"] is not None:
+            model.base_score_ = np.asarray(blob["base_score"], np.float32)
+        if blob["bin_edges"] is not None:
+            model._bin_edges = [np.asarray(e, np.float32)
+                                for e in blob["bin_edges"]]
+        model.trees_ = [[_Tree.from_dict(t) for t in r]
+                        for r in blob["trees"]]
+        return model
+
+
+def GBTRegressor(**params) -> GradientBoostedTrees:
+    params.setdefault("objective", "reg:squarederror")
+    return GradientBoostedTrees(**params)
+
+
+def GBTClassifier(num_class: int = 2, **params) -> GradientBoostedTrees:
+    params.setdefault(
+        "objective",
+        "binary:logistic" if num_class == 2 else "multi:softprob")
+    if params["objective"] == "multi:softprob":
+        params.setdefault("num_class", num_class)
+    return GradientBoostedTrees(**params)

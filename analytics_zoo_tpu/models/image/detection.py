@@ -68,6 +68,63 @@ def decode_boxes(anchors: np.ndarray, deltas: np.ndarray,
                     axis=1)
 
 
+def encode_boxes(anchors: np.ndarray, gt_boxes: np.ndarray,
+                 variances=(0.1, 0.1, 0.2, 0.2)) -> np.ndarray:
+    """Inverse of :func:`decode_boxes`: per-anchor regression targets
+    for matched ground-truth boxes (ref: BboxUtil.scala encodeBoxes).
+    anchors/gt_boxes: [N, 4] x1y1x2y2 -> deltas [N, 4]."""
+    anchors = np.asarray(anchors, np.float32)
+    gt = np.asarray(gt_boxes, np.float32)
+    aw = np.maximum(anchors[:, 2] - anchors[:, 0], 1e-6)
+    ah = np.maximum(anchors[:, 3] - anchors[:, 1], 1e-6)
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    gw = np.maximum(gt[:, 2] - gt[:, 0], 1e-6)
+    gh = np.maximum(gt[:, 3] - gt[:, 1], 1e-6)
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    return np.stack([
+        (gcx - acx) / aw / variances[0],
+        (gcy - acy) / ah / variances[1],
+        np.log(gw / aw) / variances[2],
+        np.log(gh / ah) / variances[3],
+    ], axis=1)
+
+
+def match_anchors(anchors: np.ndarray, gt_boxes: np.ndarray,
+                  gt_labels: np.ndarray, iou_threshold: float = 0.5,
+                  variances=(0.1, 0.1, 0.2, 0.2)
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """SSD bipartite + per-prediction matching (ref: BboxUtil.scala
+    matchBbox): every ground truth claims its best anchor; every other
+    anchor joins its best-IoU ground truth when IoU >= threshold.
+
+    gt_labels are FOREGROUND ids (>= 1); 0 marks background.
+    Returns per-anchor (class_targets [N] int32, box_targets [N, 4]).
+    Host-side numpy: runs in the input pipeline, so XLA only ever sees
+    the static [N]/[N, 4] targets.
+    """
+    n = anchors.shape[0]
+    cls_t = np.zeros((n,), np.int32)
+    box_t = np.zeros((n, 4), np.float32)
+    gt_boxes = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+    if gt_boxes.shape[0] == 0:
+        return cls_t, box_t
+    gt_labels = np.asarray(gt_labels, np.int32).reshape(-1)
+    iou = bbox_iou(anchors, gt_boxes)            # [N, G]
+    best_gt = iou.argmax(axis=1)                 # per anchor
+    best_iou = iou[np.arange(n), best_gt]
+    matched = best_iou >= iou_threshold
+    # bipartite pass: each gt forces its single best anchor positive
+    forced = iou.argmax(axis=0)                  # per gt
+    matched[forced] = True
+    best_gt[forced] = np.arange(gt_boxes.shape[0])
+    cls_t[matched] = gt_labels[best_gt[matched]]
+    box_t[matched] = encode_boxes(anchors[matched],
+                                  gt_boxes[best_gt[matched]], variances)
+    return cls_t, box_t
+
+
 def clip_boxes(boxes: np.ndarray, height: float, width: float) -> np.ndarray:
     """(ref: BboxUtil.scala clipBoxes)."""
     boxes = np.asarray(boxes, np.float32).copy()

