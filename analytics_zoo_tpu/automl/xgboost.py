@@ -98,8 +98,17 @@ class XGBoost:
         self.models = []
         for j in range(y2.shape[1]):
             col = y2[:, j]
-            num_class = (int(col.max()) + 1
-                         if self.model_type == "classifier" else None)
+            num_class = None
+            if self.model_type == "classifier":
+                # class count over the FULL label space: a validation
+                # fold can carry a class the training fold lacks, and
+                # predict_proba/logloss must still cover it
+                hi = int(col.max())
+                if validation_data is not None:
+                    vy_all = np.asarray(validation_data[1]).reshape(
+                        len(validation_data[1]), -1)
+                    hi = max(hi, int(vy_all[:, j].max()))
+                num_class = int(config.get("num_class", hi + 1))
             m = self._new_model(num_class=num_class)
             m.fit(x, col)
             self.models.append(m)

@@ -129,10 +129,15 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None, key_padding_mask=None,
                  train: bool = False):
-        # exact (erf) gelu: matches the reference BERT/torch numerics;
-        # jax's default tanh approximation diverges ~1e-3
-        act = ((lambda t: jax.nn.gelu(t, approximate=False))
-               if self.activation == "gelu" else jax.nn.relu)
+        # "gelu" keeps the tanh approximation (GPT lineage + saved
+        # checkpoints); "gelu_exact" is the erf form BERT/torch use --
+        # the two diverge ~1e-3, so each model family pins its own
+        if self.activation == "gelu_exact":
+            act = lambda t: jax.nn.gelu(t, approximate=False)  # noqa: E731
+        elif self.activation == "gelu":
+            act = jax.nn.gelu
+        else:
+            act = jax.nn.relu
         attn = MultiHeadSelfAttention(
             self.hidden_size, self.n_head, attn_dropout=self.attn_dropout,
             causal=self.causal, dtype=self.dtype,
@@ -239,7 +244,8 @@ class BERTModule(nn.Module):
                 self.hidden_size, self.n_head, self.intermediate_size,
                 hidden_dropout=self.hidden_dropout,
                 attn_dropout=self.attn_dropout, causal=False,
-                ln_eps=1e-12, dtype=self.dtype, seq_axis=self.seq_axis,
+                activation="gelu_exact", ln_eps=1e-12,
+                dtype=self.dtype, seq_axis=self.seq_axis,
                 name=f"encoder_{i}")(h, key_padding_mask=attn_mask,
                                      train=train)
         pooled = jnp.tanh(nn.Dense(self.hidden_size, name="pooler")

@@ -121,12 +121,15 @@ class HttpFrontend:
     def __init__(self, input_queue, output_queue, host: str = "127.0.0.1",
                  port: int = 0, worker=None,
                  request_timeout: float = 10.0,
-                 timer: Optional[Timer] = None):
+                 timer: Optional[Timer] = None,
+                 certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None):
         self._in = input_queue
         self.router = _ResultRouter(output_queue)
         self.worker = worker
         self.request_timeout = request_timeout
         self.timer = timer or Timer()
+        self._tls = certfile is not None
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -165,7 +168,40 @@ class HttpFrontend:
                     code, payload = frontend.handle_predict(req)
                 self._reply(code, payload)
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        if self._tls:
+            # HTTPS (ref: FrontEndApp.scala:40-130 supports --https-*
+            # with cert+key). The handshake must run in the per-request
+            # worker thread, NOT the accept loop: wrapping the listening
+            # socket would let one stalled client (open connection, no
+            # ClientHello) freeze accept() and starve every other
+            # client. get_request only wraps (deferred handshake);
+            # finish_request handshakes under the connection timeout.
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=certfile, keyfile=keyfile)
+
+            class TLSServer(ThreadingHTTPServer):
+                def get_request(self):
+                    conn, addr = self.socket.accept()
+                    conn.settimeout(30.0)
+                    conn = ctx.wrap_socket(
+                        conn, server_side=True,
+                        do_handshake_on_connect=False)
+                    return conn, addr
+
+                def finish_request(self, request, client_address):
+                    try:
+                        request.do_handshake()
+                    except (ssl.SSLError, OSError) as e:
+                        logger.debug("tls handshake failed from %s: %s",
+                                     client_address, e)
+                        return
+                    super().finish_request(request, client_address)
+
+            self._server = TLSServer((host, port), Handler)
+        else:
+            self._server = ThreadingHTTPServer((host, port), Handler)
         self._server_thread: Optional[threading.Thread] = None
 
     # --------------------------------------------------------- requests --
@@ -238,7 +274,8 @@ class HttpFrontend:
     @property
     def address(self):
         host, port = self._server.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self) -> "HttpFrontend":
         self.router.start()

@@ -16,8 +16,9 @@ Config schema (defaults in parentheses)::
       encrypted: false                   # load_encrypted_zoo
       secret: null                       #   its AES secret
     data:
-      queue: memory | dir (memory)
-      path: null                         # dir-queue directory
+      queue: memory | dir | tcp://host:port (memory)
+      path: null                         # dir-queue directory, or
+                                         # host:port when queue: tcp
       maxlen: 10000
     params:
       batch_size: 8                      # micro-batch cap (core_number)
@@ -29,6 +30,12 @@ Config schema (defaults in parentheses)::
       enabled: true
       host: 127.0.0.1
       port: 0                            # 0 = pick a free port
+      certfile: null                     # both set -> HTTPS (ref:
+      keyfile: null                      #   FrontEndApp https options)
+
+``queue: tcp://...`` points every host's worker at one TcpQueueServer
+broker -- the cross-host data plane (the reference's Redis role): run N
+workers on N hosts against the same broker address.
 
 With ``http.enabled`` the frontend OWNS the result stream (its router
 consumes every worker result, HttpFrontend's contract) -- direct queue
@@ -100,13 +107,23 @@ def launch(config: Dict[str, Any]) -> ServingApp:
 
     if data.get("queue") == "dir" and not data.get("path"):
         raise ValueError('data.queue "dir" needs data.path')
-    # backend=None lets the queues module infer dir-backing from path
-    in_q = InputQueue(backend=data.get("queue"),
-                      path=data.get("path"),
-                      maxlen=data.get("maxlen", 10000))
-    out_q = OutputQueue(backend=data.get("queue"),
-                        path=(data.get("path") + ".out"
-                              if data.get("path") else None))
+    queue_kind = data.get("queue")
+    if queue_kind == "tcp":  # docstring form: queue: tcp + path: host:port
+        if not data.get("path"):
+            raise ValueError('data.queue "tcp" needs data.path '
+                             '"host:port"')
+        queue_kind = "tcp://" + str(data["path"])
+    if isinstance(queue_kind, str) and queue_kind.startswith("tcp://"):
+        in_q = InputQueue(backend=queue_kind)
+        out_q = OutputQueue(backend=queue_kind)
+    else:
+        # backend=None lets the queues module infer dir-backing from path
+        in_q = InputQueue(backend=queue_kind,
+                          path=data.get("path"),
+                          maxlen=data.get("maxlen", 10000))
+        out_q = OutputQueue(backend=queue_kind,
+                            path=(data.get("path") + ".out"
+                                  if data.get("path") else None))
     from analytics_zoo_tpu.inference.inference_model import _bucket
 
     # default: every power-of-two bucket the micro-batcher can emit, so
@@ -135,7 +152,9 @@ def launch(config: Dict[str, Any]) -> ServingApp:
         if http.get("enabled", True):
             frontend = HttpFrontend(
                 in_q, out_q, host=http.get("host", "127.0.0.1"),
-                port=http.get("port", 0), worker=worker).start()
+                port=http.get("port", 0), worker=worker,
+                certfile=http.get("certfile"),
+                keyfile=http.get("keyfile")).start()
             logger.info("serving ready at %s", frontend.address)
     except Exception:
         worker.stop()  # no ServingApp handle escapes; don't leak it

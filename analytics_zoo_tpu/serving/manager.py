@@ -1,0 +1,165 @@
+"""Serving deployment lifecycle: start / status / stop from YAML.
+
+The analog of ``ClusterServingManager`` (ref: zoo/src/main/scala/com/
+intel/analytics/zoo/serving/ClusterServingManager.scala -- job
+lifecycle driven by the serving YAML). A deployment is one detached
+launcher process; the manager tracks it with a state file
+(``<name>.json`` with pid + config + address) under
+``~/.analytics-zoo-tpu/serving`` (override with ``state_dir``).
+
+CLI::
+
+    python -m analytics_zoo_tpu.serving.manager start  -c config.yaml
+    python -m analytics_zoo_tpu.serving.manager status [-n name]
+    python -m analytics_zoo_tpu.serving.manager stop   -n name
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from analytics_zoo_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_STATE_DIR = os.path.expanduser("~/.analytics-zoo-tpu/serving")
+
+
+def _state_path(name: str, state_dir: Optional[str]) -> str:
+    return os.path.join(state_dir or DEFAULT_STATE_DIR, f"{name}.json")
+
+
+def _alive(pid: int) -> bool:
+    if not isinstance(pid, int) or pid <= 0:
+        # a truncated state file must never reach os.kill: pid -1
+        # signals EVERY process the user can signal
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:  # exists, owned elsewhere
+        return True
+
+
+def start(config_path: str, name: Optional[str] = None,
+          state_dir: Optional[str] = None,
+          log_path: Optional[str] = None) -> Dict[str, Any]:
+    """Spawn a detached launcher for the YAML config; returns the state
+    record (name, pid, config, log)."""
+    import yaml
+
+    with open(config_path) as f:
+        config = yaml.safe_load(f) or {}
+    name = name or config.get("name") or os.path.splitext(
+        os.path.basename(config_path))[0]
+    sdir = state_dir or DEFAULT_STATE_DIR
+    os.makedirs(sdir, exist_ok=True)
+    state_file = _state_path(name, state_dir)
+    if os.path.isfile(state_file):
+        with open(state_file) as f:
+            old = json.load(f)
+        old_pid = old.get("pid", 0)
+        if _alive(old_pid):
+            raise RuntimeError(
+                f"deployment {name!r} already running (pid {old_pid}); "
+                "stop it first")
+    log_path = log_path or os.path.join(sdir, f"{name}.log")
+    log_f = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "analytics_zoo_tpu.serving.launcher",
+         "-c", os.path.abspath(config_path)],
+        stdout=log_f, stderr=subprocess.STDOUT,
+        start_new_session=True)  # detach: survives the manager exiting
+    log_f.close()
+    state = {"name": name, "pid": proc.pid,
+             "config": os.path.abspath(config_path),
+             "log": log_path, "started_at": time.time()}
+    with open(state_file, "w") as f:
+        json.dump(state, f)
+    logger.info("started deployment %s (pid %d)", name, proc.pid)
+    return state
+
+
+def status(name: Optional[str] = None,
+           state_dir: Optional[str] = None) -> List[Dict[str, Any]]:
+    """State of one (or every) tracked deployment; each record gains
+    ``running: bool``."""
+    sdir = state_dir or DEFAULT_STATE_DIR
+    if not os.path.isdir(sdir):
+        return []
+    names = ([name] if name else
+             [os.path.splitext(f)[0] for f in sorted(os.listdir(sdir))
+              if f.endswith(".json")])
+    out = []
+    for n in names:
+        path = _state_path(n, state_dir)
+        if not os.path.isfile(path):
+            continue
+        with open(path) as f:
+            state = json.load(f)
+        state["running"] = _alive(state.get("pid", -1))
+        out.append(state)
+    return out
+
+
+def stop(name: str, state_dir: Optional[str] = None,
+         grace_s: float = 10.0) -> bool:
+    """SIGTERM the deployment (SIGKILL after ``grace_s``); removes the
+    state file. Returns True if a process was stopped."""
+    path = _state_path(name, state_dir)
+    if not os.path.isfile(path):
+        return False
+    with open(path) as f:
+        state = json.load(f)
+    pid = state.get("pid", 0)
+    stopped = False
+    if _alive(pid):
+        os.kill(pid, signal.SIGTERM)
+        deadline = time.time() + grace_s
+        while _alive(pid) and time.time() < deadline:
+            time.sleep(0.1)
+        if _alive(pid):
+            os.kill(pid, signal.SIGKILL)
+        stopped = True
+        logger.info("stopped deployment %s (pid %d)", name, pid)
+    os.unlink(path)
+    return stopped
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="analytics_zoo_tpu serving manager")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_start = sub.add_parser("start")
+    p_start.add_argument("-c", "--config", required=True)
+    p_start.add_argument("-n", "--name")
+    p_start.add_argument("--state-dir")
+    p_status = sub.add_parser("status")
+    p_status.add_argument("-n", "--name")
+    p_status.add_argument("--state-dir")
+    p_stop = sub.add_parser("stop")
+    p_stop.add_argument("-n", "--name", required=True)
+    p_stop.add_argument("--state-dir")
+    args = ap.parse_args(argv)
+    if args.cmd == "start":
+        state = start(args.config, name=args.name,
+                      state_dir=args.state_dir)
+        print(json.dumps(state))
+    elif args.cmd == "status":
+        print(json.dumps(status(args.name, state_dir=args.state_dir)))
+    elif args.cmd == "stop":
+        ok = stop(args.name, state_dir=args.state_dir)
+        print(json.dumps({"stopped": ok}))
+
+
+if __name__ == "__main__":
+    main()

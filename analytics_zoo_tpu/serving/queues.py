@@ -107,7 +107,218 @@ class DirQueue:
                    if n.endswith(".item"))
 
 
-def _make_backend(backend, path: Optional[str], maxlen: Optional[int]):
+class TcpQueueServer:
+    """A tiny stream broker: named MemQueues served over TCP.
+
+    The cross-host data plane the reference delegated to Redis Streams
+    (ref: serving/engine/FlinkRedisSource.scala XREADGROUP consumer
+    groups): one broker process per serving deployment, any number of
+    producer/consumer hosts. Framed request/response per connection:
+
+      request  = op:1 (P/G/L) | name_len:2 | name | arg:4 | payload
+      response = status:1 (K/E/N) | payload_len:4 | payload
+
+    P(ut): arg = payload length, K/E(full) back. G(et): arg = timeout
+    in ms, K+payload or N(othing). L(en): K + 4-byte count.
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 maxlen: Optional[int] = 10000):
+        import socket
+
+        self._maxlen = maxlen
+        self._queues: Dict[str, MemQueue] = {}
+        self._qlock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._sock.getsockname()[:2]
+        return f"tcp://{host}:{port}"
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def _queue(self, name: str) -> MemQueue:
+        with self._qlock:
+            if name not in self._queues:
+                self._queues[name] = MemQueue(self._maxlen)
+            return self._queues[name]
+
+    def start(self) -> "TcpQueueServer":
+        self._stop.clear()
+        self._sock.settimeout(0.2)
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        self._sock.close()
+
+    def _accept_loop(self):
+        import socket
+
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        import struct as _struct
+
+        try:
+            conn.settimeout(None)
+            while not self._stop.is_set():
+                head = _recv_exact(conn, 7)
+                if head is None:
+                    return
+                op = chr(head[0])
+                (nlen,) = _struct.unpack(">H", head[1:3])
+                (arg,) = _struct.unpack(">I", head[3:7])
+                name = _recv_exact(conn, nlen)
+                if name is None:
+                    return
+                q = self._queue(name.decode())
+                if op == "P":
+                    payload = _recv_exact(conn, arg)
+                    if payload is None:
+                        return
+                    ok = q.put(payload)
+                    conn.sendall((b"K" if ok else b"E")
+                                 + _struct.pack(">I", 0))
+                elif op == "G":
+                    blob = q.get(timeout=arg / 1000.0)
+                    if blob is None:
+                        conn.sendall(b"N" + _struct.pack(">I", 0))
+                    else:
+                        conn.sendall(b"K" + _struct.pack(">I", len(blob))
+                                     + blob)
+                elif op == "L":
+                    n = _struct.pack(">I", len(q))
+                    conn.sendall(b"K" + _struct.pack(">I", 4) + n)
+                else:
+                    return
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+
+def _recv_exact(conn, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class TcpQueue:
+    """Client backend for :class:`TcpQueueServer`; address
+    ``tcp://host:port`` plus a stream name. Reconnects per failure,
+    thread-safe via one lock (a connection carries one in-flight
+    request at a time)."""
+
+    def __init__(self, address: str, name: str = "serving_stream"):
+        if address.startswith("tcp://"):
+            address = address[len("tcp://"):]
+        host, port = address.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._name = name.encode()
+        self._conn = None
+        self._lock = threading.Lock()
+
+    # server-side wait per G request; long client timeouts poll in
+    # slices so the socket deadline always exceeds the blocking wait
+    # and an abandoned request can't strand an item on a dead socket
+    _GET_SLICE_S = 2.0
+
+    def _connect(self):
+        import socket
+
+        if self._conn is None:
+            self._conn = socket.create_connection(
+                (self._host, self._port), timeout=30.0)
+        return self._conn
+
+    def _request(self, op: bytes, arg: int, payload: bytes = b"",
+                 retry: bool = True, wait_s: float = 0.0):
+        import struct as _struct
+
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    conn = self._connect()
+                    # recv deadline must cover the server-side wait
+                    conn.settimeout(30.0 + wait_s)
+                    conn.sendall(op + _struct.pack(">H", len(self._name))
+                                 + _struct.pack(">I", arg)
+                                 + self._name + payload)
+                    head = _recv_exact(conn, 5)
+                    if head is None:
+                        raise OSError("connection closed")
+                    status = chr(head[0])
+                    (plen,) = _struct.unpack(">I", head[1:5])
+                    body = _recv_exact(conn, plen) if plen else b""
+                    if plen and body is None:
+                        raise OSError("connection closed mid-body")
+                    return status, body
+                except OSError:
+                    self._conn = None
+                    if attempt or not retry:
+                        raise
+        raise OSError("unreachable")
+
+    def put(self, item: bytes) -> bool:
+        status, _ = self._request(b"P", len(item), item)
+        return status == "K"
+
+    def get(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        deadline = time.monotonic() + max(0.0, timeout or 0.0)
+        while True:
+            remaining = deadline - time.monotonic()
+            wait = min(max(remaining, 0.0), self._GET_SLICE_S)
+            # no blind retry on G: a re-sent request after a half-done
+            # one could pop an item onto a dead connection
+            status, body = self._request(b"G", int(wait * 1000),
+                                         retry=False, wait_s=wait)
+            if status == "K":
+                return body
+            if time.monotonic() >= deadline:
+                return None
+
+    def __len__(self) -> int:
+        import struct as _struct
+
+        status, body = self._request(b"L", 0)
+        return _struct.unpack(">I", body)[0] if status == "K" else 0
+
+
+def _make_backend(backend, path: Optional[str], maxlen: Optional[int],
+                  name: str = "serving_stream"):
+    if isinstance(backend, str) and backend.startswith("tcp://"):
+        return TcpQueue(backend, name=name)
+    if backend == "tcp":
+        if not path or "://" not in str(path) and ":" not in str(path):
+            raise ValueError('backend "tcp" needs path "host:port"')
+        return TcpQueue(str(path), name=name)
     if backend == "memory" or (backend is None and path is None):
         return MemQueue(maxlen)
     if backend == "dir" or path is not None:
@@ -116,12 +327,15 @@ def _make_backend(backend, path: Optional[str], maxlen: Optional[int]):
 
 
 class InputQueue:
-    """(ref: client.py InputQueue.enqueue/predict)."""
+    """(ref: client.py InputQueue.enqueue/predict). ``backend`` may be
+    a ``tcp://host:port`` broker address (cross-host data plane);
+    ``name`` is the stream on that broker (ref: serving_stream)."""
 
     def __init__(self, backend=None, path: Optional[str] = None,
-                 maxlen: Optional[int] = 10000, queue=None):
+                 maxlen: Optional[int] = 10000, queue=None,
+                 name: str = "serving_stream"):
         self._q = queue if queue is not None else _make_backend(
-            backend, path, maxlen)
+            backend, path, maxlen, name=name)
 
     @property
     def queue(self):
@@ -137,12 +351,15 @@ class InputQueue:
 
 
 class OutputQueue:
-    """(ref: client.py OutputQueue.dequeue/query)."""
+    """(ref: client.py OutputQueue.dequeue/query). ``backend`` may be a
+    ``tcp://host:port`` broker address; ``name`` defaults to the result
+    stream (ref: result XADD stream)."""
 
     def __init__(self, backend=None, path: Optional[str] = None,
-                 maxlen: Optional[int] = None, queue=None):
+                 maxlen: Optional[int] = None, queue=None,
+                 name: str = "result_stream"):
         self._q = queue if queue is not None else _make_backend(
-            backend, path, maxlen)
+            backend, path, maxlen, name=name)
 
     @property
     def queue(self):

@@ -1,0 +1,231 @@
+"""Serving hardening (VERDICT r2 item 9): HTTPS frontend, TCP-broker
+cross-host data plane, manager lifecycle."""
+
+import json
+import os
+import ssl
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
+from analytics_zoo_tpu.serving.queues import (
+    InputQueue, OutputQueue, TcpQueue, TcpQueueServer)
+from analytics_zoo_tpu.serving.worker import ServingWorker
+
+
+class _EchoModel:
+    def predict(self, x):
+        return np.asarray(x) * 2.0
+
+
+def _self_signed_cert(tmp_path):
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout",
+         str(key), "-out", str(cert), "-days", "1", "-nodes", "-subj",
+         "/CN=localhost"],
+        check=True, capture_output=True)
+    return str(cert), str(key)
+
+
+class TestTcpQueue:
+    def test_put_get_len_roundtrip(self):
+        server = TcpQueueServer(host="127.0.0.1").start()
+        try:
+            q = TcpQueue(server.address, name="s1")
+            assert len(q) == 0
+            assert q.put(b"hello")
+            assert q.put(b"world")
+            assert len(q) == 2
+            assert q.get(timeout=1.0) == b"hello"
+            assert q.get(timeout=1.0) == b"world"
+            assert q.get(timeout=0.05) is None
+        finally:
+            server.stop()
+
+    def test_streams_are_independent(self):
+        server = TcpQueueServer(host="127.0.0.1").start()
+        try:
+            a = TcpQueue(server.address, name="a")
+            b = TcpQueue(server.address, name="b")
+            a.put(b"for-a")
+            assert b.get(timeout=0.05) is None
+            assert a.get(timeout=0.5) == b"for-a"
+        finally:
+            server.stop()
+
+    def test_multiple_consumers_split_work(self):
+        server = TcpQueueServer(host="127.0.0.1").start()
+        try:
+            prod = TcpQueue(server.address)
+            for i in range(20):
+                prod.put(f"item-{i}".encode())
+            got = []
+            lock = threading.Lock()
+
+            def consume():
+                q = TcpQueue(server.address)
+                while True:
+                    item = q.get(timeout=0.2)
+                    if item is None:
+                        return
+                    with lock:
+                        got.append(item)
+
+            threads = [threading.Thread(target=consume)
+                       for _ in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10.0)
+            assert sorted(got) == sorted(
+                f"item-{i}".encode() for i in range(20))
+        finally:
+            server.stop()
+
+    def test_get_timeout_longer_than_poll_slice(self):
+        """Long waits poll in slices (regression: a 30s socket timeout
+        used to kill any get(timeout > 30) mid-wait)."""
+        server = TcpQueueServer(host="127.0.0.1").start()
+        try:
+            q = TcpQueue(server.address, name="slow")
+
+            def later():
+                time.sleep(TcpQueue._GET_SLICE_S + 1.0)
+                TcpQueue(server.address, name="slow").put(b"late")
+
+            threading.Thread(target=later, daemon=True).start()
+            t0 = time.time()
+            got = q.get(timeout=TcpQueue._GET_SLICE_S * 5)
+            assert got == b"late"
+            assert time.time() - t0 >= TcpQueue._GET_SLICE_S
+        finally:
+            server.stop()
+
+    def test_serving_worker_through_tcp_broker(self):
+        """Full data plane over the broker: client enqueues, a worker
+        (wired exactly as the launcher wires a tcp:// deployment)
+        serves, client dequeues."""
+        server = TcpQueueServer(host="127.0.0.1").start()
+        try:
+            in_q = InputQueue(backend=server.address)
+            out_q = OutputQueue(backend=server.address)
+            worker = ServingWorker(_EchoModel(), in_q, out_q,
+                                   batch_size=4, timeout_ms=2.0).start()
+            try:
+                client_in = InputQueue(backend=server.address)
+                client_out = OutputQueue(backend=server.address)
+                for i in range(6):
+                    assert client_in.enqueue(
+                        f"r{i}", x=np.full((2,), float(i), np.float32))
+                deadline = time.time() + 10
+                results = {}
+                while len(results) < 6 and time.time() < deadline:
+                    for uri, tensors in client_out.dequeue_all():
+                        results[uri] = tensors
+                    time.sleep(0.01)
+                assert len(results) == 6
+                np.testing.assert_allclose(results["r3"]["output"],
+                                           [6.0, 6.0])
+            finally:
+                worker.stop()
+        finally:
+            server.stop()
+
+
+class TestHttpsFrontend:
+    def test_tls_predict_roundtrip(self, tmp_path):
+        cert, key = _self_signed_cert(tmp_path)
+        in_q = InputQueue()
+        out_q = OutputQueue()
+        worker = ServingWorker(_EchoModel(), in_q, out_q,
+                               batch_size=2, timeout_ms=1.0).start()
+        fe = HttpFrontend(in_q, out_q, worker=worker,
+                          certfile=cert, keyfile=key).start()
+        try:
+            assert fe.address.startswith("https://")
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            body = json.dumps(
+                {"inputs": {"x": [1.0, 2.0]}}).encode()
+            req = urllib.request.Request(
+                fe.address + "/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, context=ctx,
+                                        timeout=10) as r:
+                out = json.loads(r.read())
+            np.testing.assert_allclose(out["predictions"]["output"],
+                                       [2.0, 4.0])
+            # plain HTTP against the TLS port must fail
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    fe.address.replace("https", "http") + "/metrics",
+                    timeout=3)
+        finally:
+            fe.stop()
+            worker.stop()
+
+
+class TestManager:
+    def test_start_status_stop(self, tmp_path):
+        import yaml
+
+        from analytics_zoo_tpu.serving import manager
+
+        # a deployment needs a saved model; use the tiny NCF zoo model
+        sys.path.insert(0, "/root/repo")
+        from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF
+
+        mdir = str(tmp_path / "model")
+        NeuralCF(user_count=15, item_count=15, class_num=5,
+                 user_embed=4, item_embed=4, hidden_layers=(8,),
+                 mf_embed=4).save_model(mdir)
+        cfg = {"model": {"path": mdir},
+               "params": {"batch_size": 2, "warm_batch_sizes": []},
+               "http": {"enabled": True, "port": 0}}
+        cfg_path = str(tmp_path / "serving.yaml")
+        with open(cfg_path, "w") as f:
+            yaml.safe_dump(cfg, f)
+        sdir = str(tmp_path / "state")
+
+        state = manager.start(cfg_path, state_dir=sdir)
+        try:
+            assert state["name"] == "serving"
+            # duplicate start must refuse
+            with pytest.raises(RuntimeError):
+                manager.start(cfg_path, state_dir=sdir)
+            sts = manager.status(state_dir=sdir)
+            assert len(sts) == 1 and sts[0]["running"]
+            deadline = time.time() + 90
+            # wait for the deployment to come up enough to be stopped
+            while time.time() < deadline:
+                if os.path.isfile(state["log"]):
+                    break
+                time.sleep(0.2)
+        finally:
+            assert manager.stop("serving", state_dir=sdir)
+        assert manager.status(state_dir=sdir) == []
+        # stopping a non-tracked name is a no-op
+        assert manager.stop("missing", state_dir=sdir) is False
+
+    def test_truncated_state_file_never_signals(self, tmp_path):
+        """A state file without a pid must be a safe no-op (regression:
+        pid -1 would have signalled every process on the host)."""
+        from analytics_zoo_tpu.serving import manager
+
+        sdir = tmp_path / "state"
+        sdir.mkdir()
+        with open(sdir / "broken.json", "w") as f:
+            json.dump({"name": "broken"}, f)
+        assert manager.stop("broken", state_dir=str(sdir)) is False
+        assert not (sdir / "broken.json").exists()
+        assert manager._alive(-1) is False
+        assert manager._alive(0) is False
