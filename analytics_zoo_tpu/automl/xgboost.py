@@ -63,14 +63,21 @@ class XGBoost:
 
             cls = (XGBRegressor if self.model_type == "regressor"
                    else XGBClassifier)
-            return cls(n_estimators=c["n_estimators"],
-                       max_depth=c["max_depth"],
-                       learning_rate=c["learning_rate"],
-                       min_child_weight=c["min_child_weight"],
-                       subsample=c["subsample"],
-                       colsample_bytree=c["colsample_bytree"],
-                       gamma=c["gamma"], reg_lambda=c["reg_lambda"],
-                       random_state=c["seed"], tree_method="hist")
+            kwargs = dict(n_estimators=c["n_estimators"],
+                          max_depth=c["max_depth"],
+                          learning_rate=c["learning_rate"],
+                          min_child_weight=c["min_child_weight"],
+                          subsample=c["subsample"],
+                          colsample_bytree=c["colsample_bytree"],
+                          gamma=c["gamma"], reg_lambda=c["reg_lambda"],
+                          random_state=c["seed"], tree_method="hist")
+            if (self.model_type == "classifier" and num_class
+                    and num_class > 2):
+                # the full-label-space class count must reach the real
+                # engine too, or a validation-only class breaks scoring
+                kwargs.update(objective="multi:softprob",
+                              num_class=num_class)
+            return cls(**kwargs)
         if self.model_type == "regressor":
             objective = "reg:squarederror"
         else:

@@ -115,7 +115,18 @@ def launch(config: Dict[str, Any]) -> ServingApp:
         queue_kind = "tcp://" + str(data["path"])
     if isinstance(queue_kind, str) and queue_kind.startswith("tcp://"):
         in_q = InputQueue(backend=queue_kind)
-        out_q = OutputQueue(backend=queue_kind)
+        if http.get("enabled", True):
+            # each deployment's frontend owns a UNIQUE result stream on
+            # the broker (requests carry it as reply-to): N frontends
+            # sharing one broker would otherwise race on one result
+            # stream and drop each other's results
+            import uuid as _uuid
+
+            reply = f"result_{_uuid.uuid4().hex[:12]}"
+            in_q.reply_stream = reply
+            out_q = OutputQueue(backend=queue_kind, name=reply)
+        else:
+            out_q = OutputQueue(backend=queue_kind)
     else:
         # backend=None lets the queues module infer dir-backing from path
         in_q = InputQueue(backend=queue_kind,

@@ -42,6 +42,12 @@ def _alive(pid: int) -> bool:
         # signals EVERY process the user can signal
         return False
     try:
+        # reap if it's our zombie child: without this, a dead launcher
+        # spawned by THIS process keeps answering kill(pid, 0) forever
+        os.waitpid(pid, os.WNOHANG)
+    except ChildProcessError:
+        pass  # not our child (manager CLI from another process)
+    try:
         os.kill(pid, 0)
         return True
     except ProcessLookupError:

@@ -24,17 +24,35 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 
-def _encode(uri: str, payload: Dict[str, np.ndarray]) -> bytes:
+def _encode(uri: str, payload: Dict[str, np.ndarray],
+            reply_to: Optional[str] = None) -> bytes:
     buf = io.BytesIO()
-    np.savez(buf, __uri__=np.asarray(uri),
+    extra = {}
+    if reply_to:
+        # reply-to stream for brokered deployments: the worker that
+        # serves the request routes the result back to the REQUESTER'S
+        # result stream (several frontends can share one broker)
+        extra["__reply__"] = np.asarray(reply_to)
+    np.savez(buf, __uri__=np.asarray(uri), **extra,
              **{k: np.asarray(v) for k, v in payload.items()})
     return buf.getvalue()
 
 
+_META_KEYS = ("__uri__", "__reply__")
+
+
 def _decode(blob: bytes) -> Tuple[str, Dict[str, np.ndarray]]:
+    uri, tensors, _ = _decode_full(blob)
+    return uri, tensors
+
+
+def _decode_full(blob: bytes
+                 ) -> Tuple[str, Dict[str, np.ndarray], Optional[str]]:
     with np.load(io.BytesIO(blob), allow_pickle=False) as z:
         uri = str(z["__uri__"])
-        return uri, {k: z[k] for k in z.files if k != "__uri__"}
+        reply = str(z["__reply__"]) if "__reply__" in z.files else None
+        return uri, {k: z[k] for k in z.files
+                     if k not in _META_KEYS}, reply
 
 
 class MemQueue:
@@ -242,30 +260,30 @@ class TcpQueue:
         host, port = address.rsplit(":", 1)
         self._host, self._port = host, int(port)
         self._name = name.encode()
-        self._conn = None
-        self._lock = threading.Lock()
+        # separate channels: a blocking G wait (up to _GET_SLICE_S)
+        # must not hold up P/L callers sharing this object
+        self._chan = {"main": [None, threading.Lock()],
+                      "get": [None, threading.Lock()]}
 
     # server-side wait per G request; long client timeouts poll in
     # slices so the socket deadline always exceeds the blocking wait
     # and an abandoned request can't strand an item on a dead socket
     _GET_SLICE_S = 2.0
 
-    def _connect(self):
-        import socket
-
-        if self._conn is None:
-            self._conn = socket.create_connection(
-                (self._host, self._port), timeout=30.0)
-        return self._conn
-
     def _request(self, op: bytes, arg: int, payload: bytes = b"",
-                 retry: bool = True, wait_s: float = 0.0):
+                 retry: bool = True, wait_s: float = 0.0,
+                 channel: str = "main"):
+        import socket
         import struct as _struct
 
-        with self._lock:
+        chan = self._chan[channel]
+        with chan[1]:
             for attempt in (0, 1):
                 try:
-                    conn = self._connect()
+                    if chan[0] is None:
+                        chan[0] = socket.create_connection(
+                            (self._host, self._port), timeout=30.0)
+                    conn = chan[0]
                     # recv deadline must cover the server-side wait
                     conn.settimeout(30.0 + wait_s)
                     conn.sendall(op + _struct.pack(">H", len(self._name))
@@ -281,7 +299,7 @@ class TcpQueue:
                         raise OSError("connection closed mid-body")
                     return status, body
                 except OSError:
-                    self._conn = None
+                    chan[0] = None
                     if attempt or not retry:
                         raise
         raise OSError("unreachable")
@@ -298,7 +316,8 @@ class TcpQueue:
             # no blind retry on G: a re-sent request after a half-done
             # one could pop an item onto a dead connection
             status, body = self._request(b"G", int(wait * 1000),
-                                         retry=False, wait_s=wait)
+                                         retry=False, wait_s=wait,
+                                         channel="get")
             if status == "K":
                 return body
             if time.monotonic() >= deadline:
@@ -333,9 +352,14 @@ class InputQueue:
 
     def __init__(self, backend=None, path: Optional[str] = None,
                  maxlen: Optional[int] = 10000, queue=None,
-                 name: str = "serving_stream"):
+                 name: str = "serving_stream",
+                 reply_stream: Optional[str] = None):
         self._q = queue if queue is not None else _make_backend(
             backend, path, maxlen, name=name)
+        # when set, every request carries this reply-to stream so the
+        # serving worker routes its result back to THIS producer's
+        # result stream (brokered multi-frontend deployments)
+        self.reply_stream = reply_stream
 
     @property
     def queue(self):
@@ -344,7 +368,8 @@ class InputQueue:
     def enqueue(self, uri: str, **tensors) -> bool:
         """False means the queue is full (backpressure; the reference
         surfaces Redis OOM errors here, client.py:176-192)."""
-        return self._q.put(_encode(uri, tensors))
+        return self._q.put(_encode(uri, tensors,
+                                   reply_to=self.reply_stream))
 
     def __len__(self):
         return len(self._q)

@@ -20,7 +20,8 @@ import numpy as np
 
 from analytics_zoo_tpu.common.log import get_logger
 from analytics_zoo_tpu.serving.batcher import MicroBatcher
-from analytics_zoo_tpu.serving.queues import _decode, _encode
+from analytics_zoo_tpu.serving.queues import (
+    TcpQueue, _decode_full, _encode)
 from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
@@ -84,6 +85,11 @@ class ServingWorker:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.served = 0
+        # reply-to routing for brokered deployments: requests may name
+        # the result stream of the frontend that issued them; results
+        # go there instead of the default output queue
+        self._reply_of: Dict[str, str] = {}
+        self._reply_queues: Dict[str, Any] = {}
 
     # ------------------------------------------------------------ loop --
     def process_one_batch(self, wait_timeout: float = 1.0) -> int:
@@ -96,7 +102,10 @@ class ServingWorker:
             items: List[Tuple[str, Dict[str, np.ndarray]]] = []
             for b in blobs:
                 try:
-                    items.append(_decode(b))
+                    uri, tensors, reply = _decode_full(b)
+                    items.append((uri, tensors))
+                    if reply:
+                        self._reply_of[uri] = reply
                 except Exception as e:  # malformed blob: drop, keep serving
                     logger.exception("serving: undecodable request "
                                      "dropped: %s", e)
@@ -153,10 +162,22 @@ class ServingWorker:
         return len(group)
 
     def _push(self, uri: str, tensors: Dict[str, np.ndarray]) -> None:
-        backend = getattr(self._out_q, "queue", self._out_q)
+        backend = self._reply_backend(self._reply_of.pop(uri, None))
         if not backend.put(_encode(uri, tensors)):
             logger.warning("output queue full: dropping result for %s",
                            uri)
+
+    def _reply_backend(self, reply_to: Optional[str]):
+        """Default output backend, or the named stream on the same TCP
+        broker when the request carried a reply-to (several frontends
+        sharing one broker each get their own results back)."""
+        default = getattr(self._out_q, "queue", self._out_q)
+        if not reply_to or not isinstance(default, TcpQueue):
+            return default
+        if reply_to not in self._reply_queues:
+            self._reply_queues[reply_to] = TcpQueue(
+                f"tcp://{default._host}:{default._port}", name=reply_to)
+        return self._reply_queues[reply_to]
 
     def _push_error(self, uri: str, message: str) -> None:
         # reserved out-of-band key (the "__uri__" convention of

@@ -158,21 +158,45 @@ class TCMFForecaster:
 
     def __init__(self, rank: int = 8, tcn_levels: int = 3,
                  tcn_hidden: int = 32, kernel_size: int = 3,
-                 window: int = 16, lr: float = 0.01, seed: int = 0):
+                 window: int = 16, lr: float = 0.01, seed: int = 0,
+                 use_local: bool = False):
         self.rank = rank
         self.window = window
         self.lr = lr
         self.seed = seed
+        self.use_local = use_local
         self.tcn = TCN(levels=tcn_levels, hidden=tcn_hidden,
                        kernel_size=kernel_size, dropout=0.0,
                        output_dim=rank)
+        # DeepGLO's per-series "local" model: a second TCN over
+        # [series value, global reconstruction] covariate windows that
+        # predicts the FINAL value -- the global factorization captures
+        # shared structure, the local model the per-series residual
+        # (ref: automl/model/tcmf/local_model.py:705)
+        self.local_tcn = TCN(levels=max(1, tcn_levels - 1),
+                             hidden=max(8, tcn_hidden // 2),
+                             kernel_size=kernel_size, dropout=0.0,
+                             output_dim=1)
         self.params = None
+        self.local_params = None
         self.y_mean = None
         self.y_std = None
         self._x_factors = None
+        self._yn = None
 
-    def fit(self, y: np.ndarray, epochs: int = 100) -> Dict[str, float]:
-        """y: [n_series, T]. Returns final losses."""
+    def fit(self, y: np.ndarray, epochs: int = 100,
+            local_epochs: int = 100,
+            distributed: bool = False) -> Dict[str, float]:
+        """y: [n_series, T]. Returns final losses.
+
+        ``distributed=True`` shards the series dimension (Y rows and F
+        rows) over the context mesh's data axis -- the scale-out story
+        DeepGLO got from distributed torch fit
+        (ref: tcmf_model.py distributed fit): the factor matmul and the
+        losses partition by series, X and the TCNs replicate, and XLA
+        inserts the gradient reductions. n_series must divide the data
+        axis.
+        """
         import optax
 
         y = np.asarray(y, np.float32)
@@ -185,6 +209,22 @@ class TCMFForecaster:
         self.y_std = np.where(y.std(axis=1, keepdims=True) < 1e-8, 1.0,
                               y.std(axis=1, keepdims=True))
         yn = jnp.asarray((y - self.y_mean) / self.y_std)
+
+        series_sharding = None
+        if distributed:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from analytics_zoo_tpu.parallel.mesh import (
+                default_mesh, mesh_axis_size)
+
+            mesh = default_mesh()
+            dp = mesh_axis_size(mesh, "data")
+            if n % dp != 0:
+                raise ValueError(
+                    f"n_series {n} must be divisible by the data-axis "
+                    f"size ({dp})")
+            series_sharding = NamedSharding(mesh, P("data", None))
+            yn = jax.device_put(yn, series_sharding)
 
         rng = jax.random.PRNGKey(self.seed)
         k_f, k_x, k_t = jax.random.split(rng, 3)
@@ -199,14 +239,29 @@ class TCMFForecaster:
             # the TCN learns the nonlinear residual
             "ar": jnp.zeros((self.rank, self.window)),
         }
+        if series_sharding is not None:
+            # commit EVERY leaf to the mesh (F sharded by series, the
+            # rest replicated): a mix of mesh-committed and uncommitted
+            # inputs can wedge XLA's in-process CPU collectives
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(series_sharding.mesh, P())
+            params = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, rep), params)
+            params["F"] = jax.device_put(params["F"], series_sharding)
         tx = optax.adam(self.lr)
         opt_state = tx.init(params)
         window, tcn = self.window, self.tcn
         rollout = min(4, t - window)
 
-        def loss_fn(p):
+        def loss_fn(p, ydata, psum_axis=None):
             recon = p["F"] @ p["X"]
-            recon_loss = jnp.mean((recon - yn) ** 2)
+            if psum_axis is None:
+                recon_loss = jnp.mean((recon - ydata) ** 2)
+            else:
+                # shard_map body: local sum, one psum, global mean
+                recon_loss = jax.lax.psum(
+                    jnp.sum((recon - ydata) ** 2), psum_axis) / (n * t)
             xt = p["X"].T  # [T, rank]
             # temporal smoothness keeps the factors predictable -- the
             # TCN must learn dynamics, not memorize a jagged sequence
@@ -237,10 +292,37 @@ class TCMFForecaster:
             loss = recon_loss + fore_loss + 0.1 * smooth_loss
             return loss, (recon_loss, fore_loss)
 
+        if series_sharding is None:
+            def full_loss(p):
+                return loss_fn(p, yn)
+        else:
+            # explicit shard_map: F rows and Y rows shard by series;
+            # X / tcn / ar replicate. The ONLY collectives are the
+            # recon psum and the replicated-params gradient reductions
+            # at the shard_map boundary -- none inside the rollout
+            # scan, which wedges XLA's in-process CPU communicator
+            # when auto-partitioned.
+            from functools import partial
+
+            from jax.sharding import PartitionSpec as P
+
+            mesh = series_sharding.mesh
+            param_specs = {
+                k: (P("data", None) if k == "F"
+                    else jax.tree_util.tree_map(lambda _: P(), v))
+                for k, v in params.items()}
+            body = jax.shard_map(
+                partial(loss_fn, psum_axis="data"), mesh=mesh,
+                in_specs=(param_specs, P("data", None)),
+                out_specs=(P(), (P(), P())), check_vma=False)
+
+            def full_loss(p):
+                return body(p, yn)
+
         @jax.jit
         def step(p, s):
             (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(p)
+                full_loss, has_aux=True)(p)
             updates, s = tx.update(grads, s, p)
             return optax.apply_updates(p, updates), s, loss, aux
 
@@ -248,15 +330,109 @@ class TCMFForecaster:
         for i in range(epochs):
             params, opt_state, loss, (recon, fore) = step(params,
                                                           opt_state)
+            if series_sharding is not None and i % 8 == 7:
+                # bound the async dispatch queue: a deep pipeline of
+                # collective-bearing programs can wedge the in-process
+                # CPU communicator's rendezvous (observed at ~60 queued
+                # steps on the 8-device test mesh)
+                jax.block_until_ready(loss)
         self.params = jax.device_get(params)
         self._x_factors = self.params["X"]
+        self._yn = np.asarray(jax.device_get(yn))
         logger.info("TCMF fit: loss=%.5f recon=%.5f forecast=%.5f",
                     float(loss), float(recon), float(fore))
-        return {"loss": float(loss), "recon": float(recon),
-                "forecast": float(fore)}
+        result = {"loss": float(loss), "recon": float(recon),
+                  "forecast": float(fore)}
+        if self.use_local:
+            result["local"] = self._fit_local(yn, series_sharding,
+                                              local_epochs)
+        return result
+
+    def _fit_local(self, yn, series_sharding, epochs: int) -> float:
+        """Train the per-series local TCN on [value, global recon]
+        covariate windows -> next value (DeepGLO's hybrid stage,
+        ref: local_model.py:705). Series stay sharded when the global
+        fit was distributed."""
+        import optax
+
+        n, t = yn.shape
+        w = self.window
+        recon = jnp.asarray(self.params["F"]) @ jnp.asarray(
+            self.params["X"])
+        if series_sharding is not None:
+            recon = jax.lax.with_sharding_constraint(recon,
+                                                     series_sharding)
+        feats = jnp.stack([yn, recon], axis=-1)     # [n, t, 2]
+        starts = jnp.arange(t - w)
+
+        def windows_of(row):                         # [t, 2] -> [S, w, 2]
+            return jax.vmap(lambda s: jax.lax.dynamic_slice(
+                row, (s, 0), (w, 2)))(starts)
+
+        wins = jax.vmap(windows_of)(feats)           # [n, S, w, 2]
+        targets = jax.vmap(
+            lambda row: jax.vmap(
+                lambda s: jax.lax.dynamic_index_in_dim(
+                    row, s + w, 0, keepdims=False))(starts))(yn)
+
+        lp = self.local_tcn.init(
+            jax.random.PRNGKey(self.seed + 1),
+            jnp.zeros((1, w, 2)))["params"]
+        local_tcn = self.local_tcn
+        n_total = int(n) * int(t - w)
+
+        def loss_fn(p, win_data, tgt_data, psum_axis=None):
+            flat = win_data.reshape(-1, w, 2)
+            preds = local_tcn.apply({"params": p}, flat)[:, 0]
+            err = (preds - tgt_data.reshape(-1)) ** 2
+            if psum_axis is None:
+                return jnp.mean(err)
+            # shard_map body (same structure as the global fit: the
+            # only collectives sit at the boundary)
+            return jax.lax.psum(jnp.sum(err), psum_axis) / n_total
+
+        if series_sharding is None:
+            def full_loss(p):
+                return loss_fn(p, wins, targets)
+        else:
+            from functools import partial
+
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = series_sharding.mesh
+            lp = jax.device_put(lp, NamedSharding(mesh, P()))
+            body = jax.shard_map(
+                partial(loss_fn, psum_axis="data"), mesh=mesh,
+                in_specs=(jax.tree_util.tree_map(lambda _: P(), lp),
+                          P("data", None, None, None),
+                          P("data", None)),
+                out_specs=P(), check_vma=False)
+
+            def full_loss(p):
+                return body(p, wins, targets)
+
+        tx = optax.adam(self.lr)
+        opt_state = tx.init(lp)
+
+        @jax.jit
+        def step(p, s):
+            l, grads = jax.value_and_grad(full_loss)(p)
+            updates, s = tx.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, l
+
+        l = None
+        for i in range(epochs):
+            lp, opt_state, l = step(lp, opt_state)
+            if series_sharding is not None and i % 8 == 7:
+                jax.block_until_ready(l)  # bound the dispatch queue
+        self.local_params = jax.device_get(lp)
+        logger.info("TCMF local fit: loss=%.5f", float(l))
+        return float(l)
 
     def predict(self, horizon: int = 1) -> np.ndarray:
-        """Roll X forward ``horizon`` steps, project through F."""
+        """Roll X forward ``horizon`` steps, project through F; when
+        the local model is fitted, it refines each step from
+        [value, global] covariate windows (DeepGLO hybrid predict)."""
         if self.params is None:
             raise RuntimeError("fit first")
         xt = jnp.asarray(self.params["X"].T)  # [T, rank]
@@ -268,7 +444,26 @@ class TCMFForecaster:
             nxt = (ar + self.tcn.apply(tcn_params, win))[0]
             xt = jnp.concatenate([xt, nxt[None]], axis=0)
         x_fut = np.asarray(xt[-horizon:]).T  # [rank, horizon]
-        y_fut = self.params["F"] @ x_fut
+        f = self.params["F"]
+        y_fut = f @ x_fut                     # normalized global forecast
+        if self.local_params is not None:
+            w = self.window
+            yn_ext = jnp.asarray(self._yn)            # [n, T]
+            recon_ext = jnp.asarray(f @ self.params["X"])
+            lp = {"params": self.local_params}
+            outs = []
+            for h in range(horizon):
+                recon_h = jnp.asarray(y_fut[:, h])    # [n]
+                feats = jnp.stack(
+                    [yn_ext[:, -w:],
+                     recon_ext[:, -w:]], axis=-1)     # [n, w, 2]
+                pred = self.local_tcn.apply(lp, feats)[:, 0]
+                outs.append(np.asarray(pred))
+                yn_ext = jnp.concatenate(
+                    [yn_ext, pred[:, None]], axis=1)
+                recon_ext = jnp.concatenate(
+                    [recon_ext, recon_h[:, None]], axis=1)
+            y_fut = np.stack(outs, axis=1)
         return y_fut * self.y_std + self.y_mean
 
     def evaluate(self, y_true: np.ndarray,

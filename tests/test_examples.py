@@ -23,6 +23,9 @@ EXAMPLES = [
     "gan/gan_example.py",
     "objectdetection/object_detection.py",
     "parallel/long_context_ring_attention.py",
+    "transferlearning/dogs_vs_cats.py",
+    "imagesimilarity/image_similarity.py",
+    "chatbot/chatbot_seq2seq.py",
 ]
 
 # runs the example on the CPU backend inside the test environment

@@ -140,6 +140,45 @@ class TestTcpQueue:
             server.stop()
 
 
+class TestMultiFrontendBroker:
+    def test_two_frontends_share_one_broker(self, tmp_path):
+        """Two launcher deployments against one broker: each frontend
+        must get ITS OWN results back (reply-to routing; regression:
+        both routers used to race on one result stream)."""
+        import yaml
+
+        from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF
+        from analytics_zoo_tpu.serving.launcher import launch
+
+        mdir = str(tmp_path / "model")
+        NeuralCF(user_count=15, item_count=15, class_num=5,
+                 user_embed=4, item_embed=4, hidden_layers=(8,),
+                 mf_embed=4).save_model(mdir)
+        server = TcpQueueServer(host="127.0.0.1").start()
+        apps = []
+        try:
+            for _ in range(2):
+                apps.append(launch({
+                    "model": {"path": mdir},
+                    "data": {"queue": server.address},
+                    "params": {"batch_size": 2,
+                               "warm_batch_sizes": []},
+                    "http": {"enabled": True, "port": 0},
+                }))
+            body = json.dumps({"inputs": {"x": [[3, 7]]}}).encode()
+            for app in apps:
+                req = urllib.request.Request(
+                    app.address + "/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    out = json.loads(r.read())
+                assert "predictions" in out, out
+        finally:
+            for app in apps:
+                app.stop()
+            server.stop()
+
+
 class TestHttpsFrontend:
     def test_tls_predict_roundtrip(self, tmp_path):
         cert, key = _self_signed_cert(tmp_path)
@@ -181,7 +220,6 @@ class TestManager:
         from analytics_zoo_tpu.serving import manager
 
         # a deployment needs a saved model; use the tiny NCF zoo model
-        sys.path.insert(0, "/root/repo")
         from analytics_zoo_tpu.models.recommendation.ncf import NeuralCF
 
         mdir = str(tmp_path / "model")

@@ -78,6 +78,76 @@ def test_tcmf_forecaster_low_rank_recovery():
     assert res["mse"] < 0.1 * np.var(y)  # far beats predict-the-mean
 
 
+def test_tcmf_local_model_hybrid():
+    """DeepGLO hybrid: the per-series local model refines the global
+    factorization forecast (must at least stay in the same accuracy
+    class on low-rank data, and exercise the full local path)."""
+    rng = np.random.RandomState(1)
+    t = np.arange(80)
+    basis = np.stack([np.sin(t / 6.0), np.cos(t / 9.0)])
+    y = (rng.randn(6, 2) @ basis).astype(np.float32)
+    train, future = y[:, :72], y[:, 72:]
+    f = TCMFForecaster(rank=4, tcn_levels=2, tcn_hidden=16, window=12,
+                       lr=0.02, use_local=True)
+    losses = f.fit(train, epochs=300, local_epochs=200)
+    assert "local" in losses and np.isfinite(losses["local"])
+    assert f.local_params is not None
+    pred = f.predict(horizon=8)
+    assert pred.shape == (6, 8)
+    res = f.evaluate(future, metrics=["mse"])
+    assert res["mse"] < 0.2 * np.var(y), res
+
+
+def test_tcmf_distributed_fit_matches_single():
+    """Series-sharded (data-parallel) TCMF fit must match the
+    single-device numbers -- the DeepGLO distributed-fit analog."""
+    rng = np.random.RandomState(2)
+    t = np.arange(60)
+    basis = np.stack([np.sin(t / 5.0), np.cos(t / 7.0)])
+    y = (rng.randn(8, 2) @ basis).astype(np.float32)  # 8 % 8 devices
+
+    from analytics_zoo_tpu.common.context import (
+        init_zoo_context, stop_orca_context)
+
+    f1 = TCMFForecaster(rank=3, tcn_levels=2, tcn_hidden=8, window=10,
+                        lr=0.02, seed=0)
+    r1 = f1.fit(y, epochs=60)
+    stop_orca_context()
+    try:
+        init_zoo_context(mesh_shape={"data": 8})
+        f2 = TCMFForecaster(rank=3, tcn_levels=2, tcn_hidden=8,
+                            window=10, lr=0.02, seed=0)
+        r2 = f2.fit(y, epochs=60, distributed=True)
+    finally:
+        stop_orca_context()
+    assert abs(r1["loss"] - r2["loss"]) < 5e-3, (r1, r2)
+    np.testing.assert_allclose(f1.predict(4), f2.predict(4),
+                               rtol=0.1, atol=0.1)
+
+
+def test_tcmf_distributed_with_local_model():
+    """use_local + distributed together: the local stage trains through
+    the same shard_map structure as the global fit."""
+    rng = np.random.RandomState(3)
+    t = np.arange(60)
+    basis = np.stack([np.sin(t / 5.0), np.cos(t / 7.0)])
+    y = (rng.randn(8, 2) @ basis).astype(np.float32)
+
+    from analytics_zoo_tpu.common.context import (
+        init_zoo_context, stop_orca_context)
+
+    stop_orca_context()
+    try:
+        init_zoo_context(mesh_shape={"data": 8})
+        f = TCMFForecaster(rank=3, tcn_levels=2, tcn_hidden=8,
+                           window=10, lr=0.02, seed=0, use_local=True)
+        r = f.fit(y, epochs=40, local_epochs=40, distributed=True)
+    finally:
+        stop_orca_context()
+    assert np.isfinite(r["local"])
+    assert f.predict(3).shape == (8, 3)
+
+
 def test_threshold_estimator_and_detector():
     rng = np.random.RandomState(0)
     y = rng.randn(200, 2)
