@@ -46,8 +46,9 @@ BERT_VOCAB, BERT_SEQ = 30522, 384
 BERT_BATCH = 32
 BERT_STEPS = 24
 
-# ResNet-50 synthetic-ImageNet config (ref: resnet-50-imagenet.py)
-RESNET_BATCH = 128
+# ResNet-50 synthetic-ImageNet config (ref: resnet-50-imagenet.py);
+# batch swept on v5e: 256 beats 128/512 (2246 vs 2041/2146 imgs/s)
+RESNET_BATCH = 256
 RESNET_STEPS = 8  # per epoch; dataset lives in HBM (device_cache)
 RESNET_EPOCHS = 5
 
