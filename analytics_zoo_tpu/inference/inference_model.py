@@ -246,6 +246,17 @@ class InferenceModel:
         """Thread-safe batched prediction with shape-bucket AOT cache
         (ref: doPredict, InferenceModel.scala:28-62 -- minus the model
         queue)."""
+        out, n = self.predict_async(x)
+        return jax.tree_util.tree_map(
+            lambda a: np.asarray(a)[:n], out)
+
+    def predict_async(self, x) -> Any:
+        """Dispatch prediction WITHOUT materializing results: returns
+        (device_outputs, n). jax dispatch is asynchronous, so the
+        caller can submit the next batch (overlapping its host->device
+        transfer with this batch's compute) before fetching these
+        outputs with ``np.asarray(...)[:n]``. The serving worker's
+        pipelined mode is built on this."""
         if self._apply_fn is None:
             raise RuntimeError("no model loaded")
         # canonicalize 64-bit host inputs (JSON ints/floats) to the
@@ -279,6 +290,4 @@ class InferenceModel:
                 fn = jax.jit(self._apply_fn)
                 self._compiled[key] = fn
                 logger.info("inference: compiling bucket %s", key)
-        out = fn(self.variables, padded)
-        return jax.tree_util.tree_map(
-            lambda a: np.asarray(a)[:n], out)
+        return fn(self.variables, padded), n

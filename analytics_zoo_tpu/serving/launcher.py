@@ -24,6 +24,8 @@ Config schema (defaults in parentheses)::
       batch_size: 8                      # micro-batch cap (core_number)
       timeout_ms: 5.0
       top_n: null                        # classes/scores of top-N
+      pipeline_depth: 2                  # in-flight predict batches
+                                         # (1 disables overlap)
       warm_batch_sizes: [1, 8]           # pre-compiled buckets (uses the
                                          # model's example input)
     http:
@@ -157,7 +159,8 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     worker = ServingWorker(
         model, in_q, out_q, batch_size=params.get("batch_size", 8),
         timeout_ms=params.get("timeout_ms", 5.0),
-        top_n=params.get("top_n")).start()
+        top_n=params.get("top_n"),
+        pipeline_depth=params.get("pipeline_depth", 2)).start()
     frontend = None
     try:
         if http.get("enabled", True):

@@ -13,6 +13,7 @@ serving/engine/Timer.scala:24-90).
 
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -72,7 +73,8 @@ class ServingWorker:
                  input_fn: Callable = _default_input_fn,
                  output_fn: Callable = _default_output_fn,
                  top_n: Optional[int] = None,
-                 timer: Optional[Timer] = None):
+                 timer: Optional[Timer] = None,
+                 pipeline_depth: int = 2):
         self.model = model
         self._in = getattr(input_queue, "queue", input_queue)
         self._out_q = output_queue
@@ -90,6 +92,11 @@ class ServingWorker:
         # go there instead of the default output queue
         self._reply_of: Dict[str, str] = {}
         self._reply_queues: Dict[str, Any] = {}
+        # dispatch pipelining: keep up to pipeline_depth batches in
+        # flight (predict_async), so batch n+1's host->device transfer
+        # overlaps batch n's device compute + result fetch; 1 disables
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._inflight: collections.deque = collections.deque()
 
     # ------------------------------------------------------------ loop --
     def process_one_batch(self, wait_timeout: float = 1.0) -> int:
@@ -97,7 +104,11 @@ class ServingWorker:
         with self.timer.timing("batch_wait"):
             blobs = self.batcher.next_batch(wait_timeout=wait_timeout)
         if not blobs:
-            return 0
+            n = 0
+            while self._inflight:  # idle: drain pipelined batches
+                n += self._finalize_one()
+            self.served += n
+            return n
         with self.timer.timing("decode", batch=len(blobs)):
             items: List[Tuple[str, Dict[str, np.ndarray]]] = []
             for b in blobs:
@@ -120,6 +131,10 @@ class ServingWorker:
                 for uri, _ in group:
                     self._push_error(uri, str(e))
                 n += len(group)
+        # finalize the oldest in-flight batches beyond the pipeline
+        # depth (idle cycles drain the rest -- see the early return)
+        while len(self._inflight) >= self.pipeline_depth:
+            n += self._finalize_one()
         self.served += n
         return n
 
@@ -144,22 +159,48 @@ class ServingWorker:
             }
             x = self.input_fn(stacked)
         try:
-            with self.timer.timing("predict", batch=len(group)):
-                preds = self.model.predict(x)
+            with self.timer.timing("predict_dispatch", batch=len(group)):
+                if hasattr(self.model, "predict_async"):
+                    preds, n = self.model.predict_async(x)
+                else:  # duck-typed models (tests): synchronous path
+                    preds, n = self.model.predict(x), len(group)
         except Exception as e:  # push per-request errors, keep serving
             logger.exception("serving predict failed: %s", e)
             for uri in uris:
                 self._push_error(uri, str(e))
             return len(group)
-        with self.timer.timing("postprocess", batch=len(group)):
+        self._inflight.append((uris, preds, n))
+        return 0  # counted when finalized
+
+    def _finalize_one(self) -> int:
+        """Materialize the oldest in-flight batch and push its results
+        (async dispatch errors surface here)."""
+        uris, preds, n = self._inflight.popleft()
+        import jax
+
+        try:
+            with self.timer.timing("predict_fetch", batch=len(uris)):
+                preds = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a)[:n], preds)
+        except Exception as e:
+            logger.exception("serving predict failed: %s", e)
+            for uri in uris:
+                self._push_error(uri, str(e))
+            return len(uris)
+        with self.timer.timing("postprocess", batch=len(uris)):
             for i, uri in enumerate(uris):
-                pred_i = _tree_index(preds, i)
-                if self.top_n is not None:
-                    pred_i = _top_n(np.asarray(pred_i), self.top_n)
-                    self._push(uri, pred_i)
-                else:
-                    self._push(uri, self.output_fn(pred_i))
-        return len(group)
+                try:
+                    pred_i = _tree_index(preds, i)
+                    if self.top_n is not None:
+                        pred_i = _top_n(np.asarray(pred_i), self.top_n)
+                        self._push(uri, pred_i)
+                    else:
+                        self._push(uri, self.output_fn(pred_i))
+                except Exception as e:  # output_fn bugs must not kill
+                    logger.exception(  # the serving thread
+                        "serving postprocess failed for %s: %s", uri, e)
+                    self._push_error(uri, str(e))
+        return len(uris)
 
     def _push(self, uri: str, tensors: Dict[str, np.ndarray]) -> None:
         backend = self._reply_backend(self._reply_of.pop(uri, None))
@@ -195,6 +236,12 @@ class ServingWorker:
             batches += 1
             if max_batches is not None and batches >= max_batches:
                 break
+        # a bounded run returns only after everything it pulled is
+        # answered (pipelined batches must not linger past the call)
+        while self._inflight:
+            n = self._finalize_one()
+            self.served += n
+            total += n
         return total
 
     def serve_forever(self) -> None:
@@ -209,9 +256,20 @@ class ServingWorker:
 
     def stop(self, join_timeout: float = 5.0) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(join_timeout)
+        thread = self._thread
+        if thread is not None:
+            thread.join(join_timeout)
             self._thread = None
+        if thread is not None and thread.is_alive():
+            # the worker thread is still draining (e.g. a slow first
+            # compile); it owns _inflight -- draining here too would
+            # race its popleft
+            logger.warning("serving worker still busy after %.1fs; "
+                           "in-flight batches drain on its thread",
+                           join_timeout)
+            return
+        while self._inflight:  # flush: accepted requests must answer
+            self.served += self._finalize_one()
 
     def metrics(self) -> Dict[str, Any]:
         return {"served": self.served, "stages": self.timer.summary()}

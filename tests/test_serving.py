@@ -123,7 +123,8 @@ def test_worker_end_to_end_dirqueue(tmp_path, tiny_model):
         np.testing.assert_allclose(results[uri]["output"], direct[i],
                                    rtol=1e-5)
     stats = worker.metrics()["stages"]
-    assert stats["predict"]["count"] >= 1
+    assert stats["predict_dispatch"]["count"] >= 1
+    assert stats["predict_fetch"]["count"] >= 1
 
 
 def test_worker_top_n(tiny_model):
