@@ -1,0 +1,420 @@
+"""Torch-style elementwise / shape / threshold layers.
+
+The reference's Keras library carries a band of thin torch-lineage
+layers (ref: zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/
+keras/layers/{AddConstant,MulConstant,CAdd,CMul,Mul,Scale,Exp,Log,Sqrt,
+Square,Power,Negative,Identity,Expand,ExpandDim,Squeeze,Select,Narrow,
+Max,Threshold,BinaryThreshold,HardShrink,SoftShrink,HardTanh,RReLU,
+Softmax,LayerNorm,GetShape,WithinChannelLRN2D,ShareConvolution2D}.scala
+-- each wraps the matching BigDL module). Here they are jnp one-liners
+(XLA fuses them away) or small parameterized flax modules; parameters
+follow the reference semantics (CAdd/CMul/Scale learn, the rest don't).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras.layers.base import FnModule, KerasLayer
+
+__all__ = [
+    "AddConstant", "MulConstant", "CAdd", "CMul", "Mul", "Scale",
+    "Exp", "Log", "Sqrt", "Square", "Power", "Negative", "Identity",
+    "Expand", "ExpandDim", "Squeeze", "Select", "Narrow", "Max",
+    "Threshold", "BinaryThreshold", "HardShrink", "SoftShrink",
+    "HardTanh", "RReLU", "Softmax", "LayerNorm", "GetShape",
+    "WithinChannelLRN2D", "ShareConvolution2D",
+]
+
+
+class _FnLayer(KerasLayer):
+    """KerasLayer over a pure function of the input."""
+
+    def _fn(self, x):
+        raise NotImplementedError
+
+    def _make_module(self):
+        return FnModule(fn=self._fn)
+
+
+# ------------------------------------------------------- const math --
+class AddConstant(_FnLayer):
+    """x + c (ref: AddConstant.scala)."""
+
+    def __init__(self, constant: float, **kwargs):
+        super().__init__(**kwargs)
+        self.constant = float(constant)
+
+    def _fn(self, x):
+        return x + self.constant
+
+
+class MulConstant(_FnLayer):
+    """x * c (ref: MulConstant.scala)."""
+
+    def __init__(self, constant: float, **kwargs):
+        super().__init__(**kwargs)
+        self.constant = float(constant)
+
+    def _fn(self, x):
+        return x * self.constant
+
+
+class Exp(_FnLayer):
+    def _fn(self, x):
+        return jnp.exp(x)
+
+
+class Log(_FnLayer):
+    def _fn(self, x):
+        return jnp.log(x)
+
+
+class Sqrt(_FnLayer):
+    def _fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Square(_FnLayer):
+    def _fn(self, x):
+        return jnp.square(x)
+
+
+class Power(_FnLayer):
+    """(shift + scale * x) ** power (ref: Power.scala semantics)."""
+
+    def __init__(self, power: float, scale: float = 1.0,
+                 shift: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def _fn(self, x):
+        return (self.shift + self.scale * x) ** self.power
+
+
+class Negative(_FnLayer):
+    def _fn(self, x):
+        return -x
+
+
+class Identity(_FnLayer):
+    def _fn(self, x):
+        return x
+
+
+# -------------------------------------------------- learned scaling --
+class _CAddModule(nn.Module):
+    shape: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b = self.param("bias", nn.initializers.zeros, self.shape)
+        return x + b
+
+
+class CAdd(KerasLayer):
+    """Learned per-element bias of the given shape, broadcast onto the
+    input (ref: CAdd.scala)."""
+
+    def __init__(self, shape: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.shape = tuple(int(s) for s in shape)
+
+    def _make_module(self):
+        return _CAddModule(shape=self.shape)
+
+
+class _CMulModule(nn.Module):
+    shape: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = self.param("weight", nn.initializers.ones, self.shape)
+        return x * w
+
+
+class CMul(KerasLayer):
+    """Learned per-element scale (ref: CMul.scala)."""
+
+    def __init__(self, shape: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.shape = tuple(int(s) for s in shape)
+
+    def _make_module(self):
+        return _CMulModule(shape=self.shape)
+
+
+class _MulModule(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = self.param("weight", nn.initializers.ones, ())
+        return x * w
+
+
+class Mul(KerasLayer):
+    """Single learned scalar multiplier (ref: Mul.scala)."""
+
+    def _make_module(self):
+        return _MulModule()
+
+
+class _ScaleModule(nn.Module):
+    shape: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = self.param("weight", nn.initializers.ones, self.shape)
+        b = self.param("bias", nn.initializers.zeros, self.shape)
+        return x * w + b
+
+
+class Scale(KerasLayer):
+    """Learned affine x*w + b of the given broadcast shape
+    (ref: Scale.scala = CMul then CAdd)."""
+
+    def __init__(self, shape: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.shape = tuple(int(s) for s in shape)
+
+    def _make_module(self):
+        return _ScaleModule(shape=self.shape)
+
+
+# ------------------------------------------------------- shape ops --
+class Expand(_FnLayer):
+    """Broadcast size-1 dims to the target shape (batch dim excluded;
+    ref: Expand.scala / InternalExpand)."""
+
+    def __init__(self, shape: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.shape = tuple(int(s) for s in shape)
+
+    def _fn(self, x):
+        return jnp.broadcast_to(x, (x.shape[0],) + self.shape)
+
+
+class ExpandDim(_FnLayer):
+    """Insert a size-1 axis (ref: ExpandDim.scala); ``dim`` counts
+    non-batch axes like the reference."""
+
+    def __init__(self, dim: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = int(dim)
+
+    def _fn(self, x):
+        return jnp.expand_dims(x, self.dim + 1)
+
+
+class Squeeze(_FnLayer):
+    """Drop size-1 axes (ref: Squeeze.scala); ``dim`` non-batch."""
+
+    def __init__(self, dim: Optional[int] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+    def _fn(self, x):
+        if self.dim is None:
+            keep = tuple(i for i, s in enumerate(x.shape)
+                         if i == 0 or s != 1)
+            return x.reshape(tuple(x.shape[i] for i in keep))
+        return jnp.squeeze(x, self.dim + 1)
+
+
+class Select(_FnLayer):
+    """Index one slice along a non-batch dim (ref: Select.scala)."""
+
+    def __init__(self, dim: int, index: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim, self.index = int(dim), int(index)
+
+    def _fn(self, x):
+        return jnp.take(x, self.index, axis=self.dim + 1)
+
+
+class Narrow(_FnLayer):
+    """Slice ``length`` elements from ``offset`` along a non-batch dim
+    (ref: Narrow.scala)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1, **kwargs):
+        super().__init__(**kwargs)
+        self.dim, self.offset, self.length = int(dim), int(offset), \
+            int(length)
+
+    def _fn(self, x):
+        return jax.lax.slice_in_dim(x, self.offset,
+                                    self.offset + self.length,
+                                    axis=self.dim + 1)
+
+
+class Max(_FnLayer):
+    """Max over a non-batch dim (ref: Max.scala / InternalMax)."""
+
+    def __init__(self, dim: int, keepdims: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.dim, self.keepdims = int(dim), keepdims
+
+    def _fn(self, x):
+        return jnp.max(x, axis=self.dim + 1, keepdims=self.keepdims)
+
+
+class GetShape(_FnLayer):
+    """The input's (static) shape as an int array
+    (ref: GetShape.scala)."""
+
+    def _fn(self, x):
+        return jnp.asarray(x.shape, jnp.int32)
+
+
+# ----------------------------------------------- threshold family --
+class Threshold(_FnLayer):
+    """x if x > th else value (ref: Threshold.scala)."""
+
+    def __init__(self, th: float = 1e-6, value: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.th, self.value = th, value
+
+    def _fn(self, x):
+        return jnp.where(x > self.th, x, self.value)
+
+
+class BinaryThreshold(_FnLayer):
+    """1 where x > th else 0 (ref: BinaryThreshold.scala)."""
+
+    def __init__(self, th: float = 1e-6, **kwargs):
+        super().__init__(**kwargs)
+        self.th = th
+
+    def _fn(self, x):
+        return (x > self.th).astype(jnp.float32)
+
+
+class HardShrink(_FnLayer):
+    """0 inside [-lambda, lambda] (ref: HardShrink.scala)."""
+
+    def __init__(self, value: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.value = value
+
+    def _fn(self, x):
+        return jnp.where(jnp.abs(x) > self.value, x, 0.0)
+
+
+class SoftShrink(_FnLayer):
+    """Shrink toward zero by lambda (ref: SoftShrink.scala)."""
+
+    def __init__(self, value: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.value = value
+
+    def _fn(self, x):
+        return (jnp.where(x > self.value, x - self.value, 0.0)
+                + jnp.where(x < -self.value, x + self.value, 0.0))
+
+
+class HardTanh(_FnLayer):
+    """Clip to [min_value, max_value] (ref: HardTanh.scala)."""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.min_value, self.max_value = min_value, max_value
+
+    def _fn(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class _RReLUModule(nn.Module):
+    lower: float
+    upper: float
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if train:
+            rng = self.make_rng("dropout")
+            slope = jax.random.uniform(rng, x.shape, x.dtype,
+                                       self.lower, self.upper)
+        else:
+            slope = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, x * slope)
+
+
+class RReLU(KerasLayer):
+    """Randomized leaky ReLU: slope ~ U[lower, upper] in training,
+    the mean slope at inference (ref: RReLU.scala)."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.lower, self.upper = lower, upper
+
+    def _make_module(self):
+        return _RReLUModule(lower=self.lower, upper=self.upper)
+
+
+class Softmax(_FnLayer):
+    """Softmax over the last dim (ref: Softmax.scala)."""
+
+    def _fn(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class _LayerNormModule(nn.Module):
+    eps: float
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.LayerNorm(epsilon=self.eps)(x)
+
+
+class LayerNorm(KerasLayer):
+    """Last-dim layer normalization with learned scale/bias
+    (ref: LayerNorm.scala / InternalLayerNorm)."""
+
+    def __init__(self, eps: float = 1e-5, **kwargs):
+        # the reference exposes (nOutput, eps); nOutput is inferred here
+        kwargs.pop("n_output", None)
+        super().__init__(**kwargs)
+        self.eps = eps
+
+    def _make_module(self):
+        return _LayerNormModule(eps=self.eps)
+
+
+# ------------------------------------------------------ conv extras --
+class WithinChannelLRN2D(_FnLayer):
+    """Local response normalization pooled WITHIN each channel over a
+    spatial window (ref: WithinChannelLRN2D.scala; channels-last)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, **kwargs):
+        super().__init__(**kwargs)
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def _fn(self, x):
+        sq = jnp.square(x)
+        window = (1, self.size, self.size, 1)
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, window, (1, 1, 1, 1), "SAME")
+        count = jax.lax.reduce_window(
+            jnp.ones_like(sq), 0.0, jax.lax.add, window, (1, 1, 1, 1),
+            "SAME")
+        denom = (1.0 + self.alpha * summed / count) ** self.beta
+        return x / denom
+
+
+class ShareConvolution2D(KerasLayer):
+    """API-parity alias of Convolution2D: under SPMD there is one
+    weight copy by construction, which is exactly what BigDL's
+    ShareConvolution provided (shared storage across replicas,
+    ref: ShareConvolution2D.scala)."""
+
+    def __new__(cls, *args, **kwargs):
+        from analytics_zoo_tpu.keras.layers.convolutional import (
+            Convolution2D)
+
+        return Convolution2D(*args, **kwargs)

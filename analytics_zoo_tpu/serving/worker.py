@@ -174,8 +174,18 @@ class ServingWorker:
 
     def _finalize_one(self) -> int:
         """Materialize the oldest in-flight batch and push its results
-        (async dispatch errors surface here)."""
+        (async dispatch errors surface here). Never raises: push-path
+        failures (broker down, spool disk full) must not kill the
+        serving loop -- callers sit outside the batch guard."""
         uris, preds, n = self._inflight.popleft()
+        try:
+            return self._finalize_inner(uris, preds, n)
+        except Exception as e:
+            logger.exception("serving finalize failed (results for %d "
+                             "requests lost): %s", len(uris), e)
+            return len(uris)
+
+    def _finalize_inner(self, uris, preds, n) -> int:
         import jax
 
         try:
@@ -259,15 +269,16 @@ class ServingWorker:
         thread = self._thread
         if thread is not None:
             thread.join(join_timeout)
+            if thread.is_alive():
+                # the worker thread is still draining (e.g. a slow
+                # first compile); it owns _inflight -- draining here
+                # would race its popleft. KEEP the handle so a retried
+                # stop() (or start()) still sees the live thread.
+                logger.warning("serving worker still busy after %.1fs; "
+                               "in-flight batches drain on its thread",
+                               join_timeout)
+                return
             self._thread = None
-        if thread is not None and thread.is_alive():
-            # the worker thread is still draining (e.g. a slow first
-            # compile); it owns _inflight -- draining here too would
-            # race its popleft
-            logger.warning("serving worker still busy after %.1fs; "
-                           "in-flight batches drain on its thread",
-                           join_timeout)
-            return
         while self._inflight:  # flush: accepted requests must answer
             self.served += self._finalize_one()
 

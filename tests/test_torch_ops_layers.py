@@ -1,0 +1,141 @@
+"""Torch-style layer band: numerics (golden vs torch where a torch
+equivalent exists) and trainability of the parameterized ones."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+
+
+def _run(layer, x):
+    net = Sequential([layer])
+    net.compile(optimizer="sgd", loss="mse")
+    return np.asarray(net.predict(x, batch_size=len(x)))
+
+
+RNG = np.random.RandomState(0)
+X = RNG.randn(8, 5).astype(np.float32)
+
+
+class TestElementwise:
+    def test_const_math(self):
+        np.testing.assert_allclose(_run(L.AddConstant(2.0), X), X + 2.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(_run(L.MulConstant(3.0), X), X * 3.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(_run(L.Negative(), X), -X)
+        np.testing.assert_allclose(_run(L.Square(), X), X ** 2,
+                                   rtol=1e-6)
+        pos = np.abs(X) + 0.1
+        np.testing.assert_allclose(_run(L.Sqrt(), pos), np.sqrt(pos),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_run(L.Log(), pos), np.log(pos),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_run(L.Exp(), X), np.exp(X),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            _run(L.Power(2.0, scale=0.5, shift=1.0), pos),
+            (1.0 + 0.5 * pos) ** 2.0, rtol=1e-5)
+        np.testing.assert_allclose(_run(L.Identity(), X), X)
+
+    def test_thresholds_match_torch(self):
+        import torch
+
+        t = torch.from_numpy(X)
+        np.testing.assert_allclose(
+            _run(L.HardShrink(0.5), X),
+            torch.nn.Hardshrink(0.5)(t).numpy(), rtol=1e-6)
+        np.testing.assert_allclose(
+            _run(L.SoftShrink(0.5), X),
+            torch.nn.Softshrink(0.5)(t).numpy(), rtol=1e-6)
+        np.testing.assert_allclose(
+            _run(L.HardTanh(-1.0, 1.0), X),
+            torch.nn.Hardtanh()(t).numpy(), rtol=1e-6)
+        # RReLU at inference = mean slope (torch eval mode)
+        np.testing.assert_allclose(
+            _run(L.RReLU(), X),
+            torch.nn.RReLU().eval()(t).numpy(), rtol=1e-6)
+        np.testing.assert_allclose(
+            _run(L.Softmax(), X),
+            torch.nn.Softmax(-1)(t).numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_threshold_and_binary(self):
+        out = _run(L.Threshold(0.0, -7.0), X)
+        np.testing.assert_allclose(out, np.where(X > 0, X, -7.0))
+        out = _run(L.BinaryThreshold(0.0), X)
+        np.testing.assert_allclose(out, (X > 0).astype(np.float32))
+
+    def test_layer_norm_matches_torch(self):
+        import torch
+
+        out = _run(L.LayerNorm(eps=1e-5), X)
+        want = torch.nn.LayerNorm(5, eps=1e-5)(
+            torch.from_numpy(X)).detach().numpy()
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+class TestShapeOps:
+    def test_expand_dims_squeeze_select_narrow_max(self):
+        x3 = RNG.randn(8, 1, 6).astype(np.float32)
+        assert _run(L.Expand((3, 6)), x3).shape == (8, 3, 6)
+        assert _run(L.ExpandDim(0), X).shape == (8, 1, 5)
+        assert _run(L.Squeeze(0), x3).shape == (8, 6)
+        np.testing.assert_allclose(_run(L.Select(0, 2), X), X[:, 2])
+        np.testing.assert_allclose(_run(L.Narrow(0, 1, 3), X),
+                                   X[:, 1:4])
+        np.testing.assert_allclose(_run(L.Max(0), X), X.max(1),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(_run(L.GetShape(), X),
+                                      np.asarray([8, 5], np.int32))
+
+    def test_within_channel_lrn(self):
+        img = RNG.rand(8, 6, 6, 3).astype(np.float32)
+        out = _run(L.WithinChannelLRN2D(size=3), img)
+        assert out.shape == img.shape
+        assert (np.abs(out) <= np.abs(img) + 1e-6).all()
+
+    def test_share_convolution_alias(self):
+        from analytics_zoo_tpu.keras.layers.convolutional import (
+            Convolution2D)
+
+        layer = L.ShareConvolution2D(4, 3, 3)
+        assert isinstance(layer, Convolution2D)
+
+
+class TestLearnedScaling:
+    def test_cadd_cmul_scale_mul_learn(self):
+        """Each learns to map x -> 2x + 1 (or its reachable part)."""
+        x = RNG.randn(256, 4).astype(np.float32)
+
+        from analytics_zoo_tpu.learn.optim import Adam
+
+        for layer, target in ((L.CAdd((4,)), x + 1.5),
+                              (L.CMul((4,)), x * 2.0),
+                              (L.Scale((4,)), x * 2.0 + 1.5),
+                              (L.Mul(), x * 3.0)):
+            net = Sequential([layer])
+            net.compile(optimizer=Adam(0.05), loss="mse")
+            hist = net.fit(x, target, batch_size=64, nb_epoch=60)
+            assert hist[-1]["loss"] < 0.01, (type(layer).__name__,
+                                             hist[-1])
+
+    def test_rrelu_random_in_training(self):
+        """Training mode draws random slopes (different negative
+        outputs across calls with different rng)."""
+        import jax
+
+        from analytics_zoo_tpu.keras.layers.torch_ops import _RReLUModule
+
+        m = _RReLUModule(lower=0.125, upper=1.0 / 3)
+        x = -np.ones((4, 3), np.float32)
+        v = m.init({"params": jax.random.PRNGKey(0),
+                    "dropout": jax.random.PRNGKey(1)}, x, train=True)
+        o1 = m.apply(v, x, train=True,
+                     rngs={"dropout": jax.random.PRNGKey(2)})
+        o2 = m.apply(v, x, train=True,
+                     rngs={"dropout": jax.random.PRNGKey(3)})
+        assert not np.allclose(np.asarray(o1), np.asarray(o2))
+        o_eval = np.asarray(m.apply(v, x, train=False))
+        np.testing.assert_allclose(
+            o_eval, x * (0.125 + 1.0 / 3) / 2.0, rtol=1e-6)
