@@ -33,7 +33,9 @@ from analytics_zoo_tpu.automl.recipes import (  # noqa: F401
     Seq2SeqRandomRecipe,
     SmokeRecipe,
     TCNGridRandomRecipe,
+    XgbRegressorGridRandomRecipe,
 )
+from analytics_zoo_tpu.automl.xgboost import XGBoost  # noqa: F401
 from analytics_zoo_tpu.automl.search import (  # noqa: F401
     SearchEngine,
     TrialOutput,

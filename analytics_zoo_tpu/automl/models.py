@@ -244,6 +244,11 @@ class TimeSequenceModel:
         self.n_targets = n_targets
         self.config: Dict[str, Any] = {}
         self.estimator = None
+        self._xgb = None  # gradient-boosted-trees delegate (model: XGBoost)
+
+    @staticmethod
+    def _is_xgb(config: Dict[str, Any]) -> bool:
+        return str(config.get("model", "")).upper() == "XGBOOST"
 
     # keys that tune the training loop, not the architecture: changing
     # them must NOT discard the trained estimator (fit_eval is called
@@ -283,6 +288,10 @@ class TimeSequenceModel:
         meaningless on standardized values, and search rewards must be
         comparable with pipeline.evaluate's unscaled numbers.
         """
+        if self._is_xgb(config):
+            return self._fit_eval_xgb(x, y, validation_data, unscale_fn,
+                                      config)
+        self._xgb = None  # config switched family: drop a stale delegate
         est = self._ensure_estimator(config)
         y2 = y.reshape(len(y), -1)
         batch_size = int(config.get("batch_size", 32))
@@ -298,7 +307,32 @@ class TimeSequenceModel:
             vy, pred = unscale_fn(vy), unscale_fn(pred)
         return automl_metrics.evaluate(metric, vy, pred)
 
+    def _fit_eval_xgb(self, x, y, validation_data, unscale_fn,
+                      config) -> float:
+        """XGBoost in the same TimeSequenceModel slot (ref: the
+        reference searches XGBoost through the identical fit_eval
+        contract, automl/model/XGBoost.py); trees retrain from scratch
+        each call (boosting has no warm continuation here)."""
+        from analytics_zoo_tpu.automl.xgboost import XGBoost as XGBModel
+
+        self.config = dict(config)
+        self._xgb = XGBModel("regressor", config=config)
+        y2 = np.asarray(y).reshape(len(y), -1)
+        self._xgb.fit(np.asarray(x).reshape(len(x), -1), y2)
+        vx, vy = (x, y2) if validation_data is None else (
+            validation_data[0],
+            np.asarray(validation_data[1]).reshape(
+                len(validation_data[1]), -1))
+        pred = self.predict(vx)
+        if unscale_fn is not None:
+            vy, pred = unscale_fn(vy), unscale_fn(pred)
+        metric = str(config.get("metric", "mse"))
+        return automl_metrics.evaluate(metric, vy, pred)
+
     def predict(self, x: np.ndarray, batch_size: int = 128) -> np.ndarray:
+        if self._xgb is not None:
+            return self._xgb.predict(
+                np.asarray(x).reshape(len(x), -1))
         if self.estimator is None:
             raise RuntimeError("model not fitted")
         return np.asarray(self.estimator.predict(x, batch_size=batch_size))
@@ -340,7 +374,9 @@ class TimeSequenceModel:
                 "config": _jsonable(self.config)}
         with open(os.path.join(dir_path, "ts_model.json"), "w") as f:
             json.dump(meta, f)
-        if self.estimator is not None:
+        if self._xgb is not None:
+            self._xgb.save(os.path.join(dir_path, "xgb"))
+        elif self.estimator is not None:
             self.estimator.save(os.path.join(dir_path, "ckpt"))
 
     @classmethod
@@ -349,6 +385,13 @@ class TimeSequenceModel:
             meta = json.load(f)
         model = cls(future_seq_len=meta["future_seq_len"],
                     n_targets=meta["n_targets"])
+        if cls._is_xgb(meta["config"]):
+            from analytics_zoo_tpu.automl.xgboost import (
+                XGBoost as XGBModel)
+
+            model.config = dict(meta["config"])
+            model._xgb = XGBModel.restore(os.path.join(dir_path, "xgb"))
+            return model
         model._ensure_estimator(meta["config"])
         ckpt = os.path.join(dir_path, "ckpt")
         if os.path.isdir(ckpt):
@@ -362,6 +405,10 @@ class TimeSequenceModel:
 
         from flax.serialization import to_bytes
 
+        if self._xgb is not None:
+            import pickle
+
+            return pickle.dumps(self._xgb)
         buf = io.BytesIO()
         est = self.estimator
         variables = jax.device_get(est.variables)
@@ -372,6 +419,13 @@ class TimeSequenceModel:
                          example_x: np.ndarray) -> None:
         from flax.serialization import from_bytes
 
+        if self._is_xgb(config):
+            import pickle
+
+            self.config = dict(config)
+            self._xgb = pickle.loads(blob)
+            return
+        self._xgb = None
         est = self._ensure_estimator(config)
         est._ensure_built(example_x)
         est.variables = from_bytes(jax.device_get(est.variables), blob)

@@ -173,3 +173,28 @@ class TCNGridRandomRecipe(Recipe):
             "epochs": 1,
             "past_seq_len": self.look_back,
         }
+
+
+class XgbRegressorGridRandomRecipe(Recipe):
+    """Grid/random space over the XGBoost regressor's tree params
+    (ref: the reference searches automl/model/XGBoost.py through the
+    same recipe mechanism)."""
+
+    def __init__(self, num_rand_samples: int = 1, look_back: int = 2,
+                 n_estimators=(50, 100), max_depth=(3, 5)):
+        super().__init__()
+        self.num_samples = num_rand_samples
+        self.look_back = look_back
+        self.n_estimators = list(n_estimators)
+        self.max_depth = list(max_depth)
+
+    def search_space(self, all_available_features):
+        return {
+            "selected_features": FeatureSubset(all_available_features),
+            "model": "XGBoost",
+            "n_estimators": Grid(self.n_estimators),
+            "max_depth": Grid(self.max_depth),
+            "learning_rate": Uniform(0.05, 0.3),
+            "subsample": Uniform(0.7, 1.0),
+            "past_seq_len": self.look_back,
+        }

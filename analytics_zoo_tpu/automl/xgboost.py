@@ -91,17 +91,13 @@ class XGBoost:
             n_bins=c["n_bins"])
 
     # ------------------------------------------------------------ fit --
-    def fit_eval(self, x: np.ndarray, y: np.ndarray,
-                 validation_data: Optional[Tuple] = None,
-                 **config) -> float:
-        """Fit and return the metric on validation (train if absent)
-        (ref: XGBoost.fit_eval)."""
+    def _fit(self, x, y, validation_data=None, **config):
+        """Shared training pass; returns the prepared (x, y2)."""
         self.config.update({k: v for k, v in config.items()
                             if k in _CONFIG_KEYS})
         self.metric = config.get("metric", self.metric)
         x = np.asarray(x, np.float32).reshape(len(x), -1)
-        y = np.asarray(y)
-        y2 = y.reshape(len(y), -1)
+        y2 = np.asarray(y).reshape(len(y), -1)
         self.models = []
         for j in range(y2.shape[1]):
             col = y2[:, j]
@@ -119,6 +115,22 @@ class XGBoost:
             m = self._new_model(num_class=num_class)
             m.fit(x, col)
             self.models.append(m)
+        return x, y2
+
+    def fit(self, x: np.ndarray, y: np.ndarray, **config) -> "XGBoost":
+        """Train only (no scoring pass) -- callers that score
+        separately (TimeSequenceModel) skip fit_eval's full-train-set
+        predict."""
+        self._fit(x, y, validation_data=None, **config)
+        return self
+
+    def fit_eval(self, x: np.ndarray, y: np.ndarray,
+                 validation_data: Optional[Tuple] = None,
+                 **config) -> float:
+        """Fit and return the metric on validation (train if absent)
+        (ref: XGBoost.fit_eval)."""
+        x, y2 = self._fit(x, y, validation_data=validation_data,
+                          **config)
         vx, vy = (x, y2) if validation_data is None else (
             np.asarray(validation_data[0], np.float32).reshape(
                 len(validation_data[0]), -1),

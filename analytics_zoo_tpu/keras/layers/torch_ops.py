@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.keras.layers.base import FnModule, KerasLayer
+from analytics_zoo_tpu.keras.layers.normalization import (
+    LayerNormalization as _LayerNormalization)
 
 __all__ = [
     "AddConstant", "MulConstant", "CAdd", "CMul", "Mul", "Scale",
@@ -30,6 +32,12 @@ __all__ = [
     "HardTanh", "RReLU", "Softmax", "LayerNorm", "GetShape",
     "WithinChannelLRN2D", "ShareConvolution2D",
 ]
+
+
+def _axis(dim: int) -> int:
+    """Reference layers count dims EXCLUDING batch; negative dims count
+    from the end (where no batch offset applies)."""
+    return dim if dim < 0 else dim + 1
 
 
 class _FnLayer(KerasLayer):
@@ -207,7 +215,7 @@ class ExpandDim(_FnLayer):
         self.dim = int(dim)
 
     def _fn(self, x):
-        return jnp.expand_dims(x, self.dim + 1)
+        return jnp.expand_dims(x, _axis(self.dim))
 
 
 class Squeeze(_FnLayer):
@@ -222,7 +230,7 @@ class Squeeze(_FnLayer):
             keep = tuple(i for i, s in enumerate(x.shape)
                          if i == 0 or s != 1)
             return x.reshape(tuple(x.shape[i] for i in keep))
-        return jnp.squeeze(x, self.dim + 1)
+        return jnp.squeeze(x, _axis(self.dim))
 
 
 class Select(_FnLayer):
@@ -233,7 +241,7 @@ class Select(_FnLayer):
         self.dim, self.index = int(dim), int(index)
 
     def _fn(self, x):
-        return jnp.take(x, self.index, axis=self.dim + 1)
+        return jnp.take(x, self.index, axis=_axis(self.dim))
 
 
 class Narrow(_FnLayer):
@@ -248,7 +256,7 @@ class Narrow(_FnLayer):
     def _fn(self, x):
         return jax.lax.slice_in_dim(x, self.offset,
                                     self.offset + self.length,
-                                    axis=self.dim + 1)
+                                    axis=_axis(self.dim) % x.ndim)
 
 
 class Max(_FnLayer):
@@ -259,15 +267,18 @@ class Max(_FnLayer):
         self.dim, self.keepdims = int(dim), keepdims
 
     def _fn(self, x):
-        return jnp.max(x, axis=self.dim + 1, keepdims=self.keepdims)
+        return jnp.max(x, axis=_axis(self.dim), keepdims=self.keepdims)
 
 
 class GetShape(_FnLayer):
-    """The input's (static) shape as an int array
-    (ref: GetShape.scala)."""
+    """The input's (static) shape, one row PER SAMPLE [B, ndim]
+    (ref: GetShape.scala returns the bare shape; the per-row form is
+    what survives predict's chunked batching -- a rank-1 result would
+    concatenate wrongly across batches)."""
 
     def _fn(self, x):
-        return jnp.asarray(x.shape, jnp.int32)
+        shape = jnp.asarray(x.shape, jnp.int32)
+        return jnp.broadcast_to(shape, (x.shape[0], len(x.shape)))
 
 
 # ----------------------------------------------- threshold family --
@@ -363,26 +374,15 @@ class Softmax(_FnLayer):
         return jax.nn.softmax(x, axis=-1)
 
 
-class _LayerNormModule(nn.Module):
-    eps: float
-
-    @nn.compact
-    def __call__(self, x, train: bool = False):
-        return nn.LayerNorm(epsilon=self.eps)(x)
-
-
-class LayerNorm(KerasLayer):
+class LayerNorm(_LayerNormalization):
     """Last-dim layer normalization with learned scale/bias
-    (ref: LayerNorm.scala / InternalLayerNorm)."""
+    (ref: LayerNorm.scala / InternalLayerNorm) -- the torch-style
+    (eps) spelling of :class:`LayerNormalization`."""
 
     def __init__(self, eps: float = 1e-5, **kwargs):
         # the reference exposes (nOutput, eps); nOutput is inferred here
         kwargs.pop("n_output", None)
-        super().__init__(**kwargs)
-        self.eps = eps
-
-    def _make_module(self):
-        return _LayerNormModule(eps=self.eps)
+        super().__init__(epsilon=eps, **kwargs)
 
 
 # ------------------------------------------------------ conv extras --

@@ -183,6 +183,8 @@ class ServingWorker:
         except Exception as e:
             logger.exception("serving finalize failed (results for %d "
                              "requests lost): %s", len(uris), e)
+            for uri in uris:  # no leak: reply routes die with results
+                self._reply_of.pop(uri, None)
             return len(uris)
 
     def _finalize_inner(self, uris, preds, n) -> int:

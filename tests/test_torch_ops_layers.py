@@ -86,8 +86,14 @@ class TestShapeOps:
                                    X[:, 1:4])
         np.testing.assert_allclose(_run(L.Max(0), X), X.max(1),
                                    rtol=1e-6)
-        np.testing.assert_array_equal(_run(L.GetShape(), X),
-                                      np.asarray([8, 5], np.int32))
+        # negative dims count from the end, never the batch axis
+        np.testing.assert_allclose(_run(L.Select(-1, 2), X), X[:, 2])
+        np.testing.assert_allclose(_run(L.Max(-1), X), X.max(1),
+                                   rtol=1e-6)
+        # GetShape: one row per sample (chunked-predict safe)
+        np.testing.assert_array_equal(
+            _run(L.GetShape(), X),
+            np.broadcast_to(np.asarray([8, 5], np.int32), (8, 2)))
 
     def test_within_channel_lrn(self):
         img = RNG.rand(8, 6, 6, 3).astype(np.float32)

@@ -129,6 +129,41 @@ class TestAutoMLXGBoost:
         np.testing.assert_allclose(multi, -np.log(0.8), rtol=1e-6)
 
 
+class TestAutoMLSearchXGB:
+    def test_predictor_searches_xgboost(self, tmp_path):
+        """End-to-end AutoTS-style search with the XGBoost recipe:
+        trial -> best rebuild -> pipeline predict/evaluate ->
+        save/load round-trip."""
+        import pandas as pd
+
+        from analytics_zoo_tpu.automl import (
+            TimeSequencePredictor, XgbRegressorGridRandomRecipe)
+        from analytics_zoo_tpu.automl.pipeline import load_ts_pipeline
+
+        rng = np.random.RandomState(0)
+        t = pd.date_range("2025-01-01", periods=220, freq="h")
+        values = (np.sin(np.arange(220) / 8.0)
+                  + 0.05 * rng.randn(220)).astype(np.float32)
+        df = pd.DataFrame({"datetime": t, "value": values})
+        train, valid = df.iloc[:180], df.iloc[180:]
+
+        pred = TimeSequencePredictor(future_seq_len=1)
+        pipeline = pred.fit(
+            train, validation_df=valid,
+            recipe=XgbRegressorGridRandomRecipe(
+                num_rand_samples=1, n_estimators=(25,), max_depth=(3,)),
+            metric="mse")
+        res = pipeline.evaluate(valid, metrics=["mse"])
+        assert np.isfinite(res["mse"])
+        # a sine wave must beat predict-the-mean by a wide margin
+        assert res["mse"] < 0.25 * np.var(values), res
+
+        pipeline.save(str(tmp_path / "pipe"))
+        back = load_ts_pipeline(str(tmp_path / "pipe"))
+        res2 = back.evaluate(valid, metrics=["mse"])
+        np.testing.assert_allclose(res2["mse"], res["mse"], rtol=1e-5)
+
+
 class TestNNFramesXGB:
     def _df(self, classifier=False):
         if classifier:
