@@ -298,9 +298,16 @@ class TimeSequenceModel:
         batch_size = max(1, min(batch_size, len(x)))
         est.fit((x, y2), batch_size=batch_size,
                 epochs=est.epoch + int(config.get("epochs", 1)))
+        return self._score(x, y2, validation_data, unscale_fn, config)
+
+    def _score(self, x, y2, validation_data, unscale_fn, config) -> float:
+        """Reward on validation (train when absent), in DATA units when
+        an unscale_fn is given -- shared by the neural and XGBoost
+        fit_eval paths so search rewards stay comparable."""
         vx, vy = (x, y2) if validation_data is None else (
             validation_data[0],
-            validation_data[1].reshape(len(validation_data[1]), -1))
+            np.asarray(validation_data[1]).reshape(
+                len(validation_data[1]), -1))
         metric = str(config.get("metric", "mse"))
         pred = self.predict(vx)
         if unscale_fn is not None:
@@ -319,15 +326,7 @@ class TimeSequenceModel:
         self._xgb = XGBModel("regressor", config=config)
         y2 = np.asarray(y).reshape(len(y), -1)
         self._xgb.fit(np.asarray(x).reshape(len(x), -1), y2)
-        vx, vy = (x, y2) if validation_data is None else (
-            validation_data[0],
-            np.asarray(validation_data[1]).reshape(
-                len(validation_data[1]), -1))
-        pred = self.predict(vx)
-        if unscale_fn is not None:
-            vy, pred = unscale_fn(vy), unscale_fn(pred)
-        metric = str(config.get("metric", "mse"))
-        return automl_metrics.evaluate(metric, vy, pred)
+        return self._score(x, y2, validation_data, unscale_fn, config)
 
     def predict(self, x: np.ndarray, batch_size: int = 128) -> np.ndarray:
         if self._xgb is not None:

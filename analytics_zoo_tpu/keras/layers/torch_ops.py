@@ -34,10 +34,23 @@ __all__ = [
 ]
 
 
-def _axis(dim: int) -> int:
+def _axis(dim: int, ndim: int) -> int:
     """Reference layers count dims EXCLUDING batch; negative dims count
-    from the end (where no batch offset applies)."""
-    return dim if dim < 0 else dim + 1
+    from the end. Out-of-range dims raise rather than silently landing
+    on the batch axis."""
+    if dim >= ndim - 1 or dim < -(ndim - 1):
+        raise ValueError(f"dim {dim} out of range for {ndim - 1} "
+                         "non-batch dims")
+    return dim % ndim if dim < 0 else dim + 1
+
+
+def _expand_axis(dim: int, ndim: int) -> int:
+    """Like ``_axis`` but the insertion point may sit one past the last
+    existing non-batch dim."""
+    if dim > ndim - 1 or dim < -ndim:
+        raise ValueError(f"dim {dim} out of range to insert into "
+                         f"{ndim - 1} non-batch dims")
+    return dim % (ndim + 1) if dim < 0 else dim + 1
 
 
 class _FnLayer(KerasLayer):
@@ -215,7 +228,7 @@ class ExpandDim(_FnLayer):
         self.dim = int(dim)
 
     def _fn(self, x):
-        return jnp.expand_dims(x, _axis(self.dim))
+        return jnp.expand_dims(x, _expand_axis(self.dim, x.ndim))
 
 
 class Squeeze(_FnLayer):
@@ -230,7 +243,7 @@ class Squeeze(_FnLayer):
             keep = tuple(i for i, s in enumerate(x.shape)
                          if i == 0 or s != 1)
             return x.reshape(tuple(x.shape[i] for i in keep))
-        return jnp.squeeze(x, _axis(self.dim))
+        return jnp.squeeze(x, _axis(self.dim, x.ndim))
 
 
 class Select(_FnLayer):
@@ -241,7 +254,7 @@ class Select(_FnLayer):
         self.dim, self.index = int(dim), int(index)
 
     def _fn(self, x):
-        return jnp.take(x, self.index, axis=_axis(self.dim))
+        return jnp.take(x, self.index, axis=_axis(self.dim, x.ndim))
 
 
 class Narrow(_FnLayer):
@@ -256,7 +269,7 @@ class Narrow(_FnLayer):
     def _fn(self, x):
         return jax.lax.slice_in_dim(x, self.offset,
                                     self.offset + self.length,
-                                    axis=_axis(self.dim) % x.ndim)
+                                    axis=_axis(self.dim, x.ndim))
 
 
 class Max(_FnLayer):
@@ -267,7 +280,8 @@ class Max(_FnLayer):
         self.dim, self.keepdims = int(dim), keepdims
 
     def _fn(self, x):
-        return jnp.max(x, axis=_axis(self.dim), keepdims=self.keepdims)
+        return jnp.max(x, axis=_axis(self.dim, x.ndim),
+                       keepdims=self.keepdims)
 
 
 class GetShape(_FnLayer):

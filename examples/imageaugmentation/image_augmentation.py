@@ -1,0 +1,66 @@
+"""Image augmentation chains, 2-D and detection-aware.
+
+The analog of apps/image-augmentation (+ image-augmentation-3d): run a
+composable op chain over an ImageSet, and a detection chain that keeps
+bounding boxes consistent through expand/flip/crop/resize.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.image import (
+    ChainedImageProcessing, ImageAspectScale, ImageBrightness,
+    ImageCenterCrop, ImageColorJitter, ImageExpand, ImageFeature,
+    ImageHFlip, ImageRandomTransformer, ImageResize, ImageSet)
+from analytics_zoo_tpu.feature.image3d import Crop3D, Rotate3D
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    n = 8 if args.quick else 64
+    rng = np.random.RandomState(0)
+
+    # --- classification chain over an ImageSet
+    images = rng.rand(n, 48, 64, 3).astype(np.float32) * 255
+    chain = ChainedImageProcessing([
+        ImageResize(40, 56),
+        ImageRandomTransformer(ImageHFlip(), prob=0.5, seed=1),
+        ImageBrightness(-16, 16, seed=2),
+        ImageColorJitter(seed=3),
+        ImageCenterCrop(32, 48),
+    ])
+    out = ImageSet.from_arrays(images).transform(chain)
+    shapes = {f.image.shape for f in out.features}
+    print(f"classification chain: {n} images -> shapes {shapes}")
+
+    # --- detection chain: boxes follow every geometric op
+    feat = ImageFeature(images[0], bboxes=[[10, 8, 30, 28]],
+                        bbox_labels=[1])
+    det_chain = ChainedImageProcessing([
+        ImageExpand(max_expand_ratio=2.0, seed=4),
+        ImageHFlip(),
+        ImageAspectScale(min_size=48, max_size=120),
+    ])
+    feat = det_chain.transform(feat)
+    print(f"detection chain: image {feat.image.shape}, "
+          f"box {np.round(feat.bboxes[0], 1).tolist()} "
+          f"(label {feat.bbox_labels[0]})")
+
+    # --- 3-D chain (the image-augmentation-3d app)
+    vol = rng.rand(24, 24, 24).astype(np.float32)
+    v = Crop3D((2, 2, 2), (20, 20, 20)).apply_image(vol)
+    v = Rotate3D(np.pi / 8, axis="z").apply_image(v)
+    print(f"3d chain: volume {vol.shape} -> {v.shape}")
+
+
+if __name__ == "__main__":
+    main()

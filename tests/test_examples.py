@@ -26,6 +26,8 @@ EXAMPLES = [
     "transferlearning/dogs_vs_cats.py",
     "imagesimilarity/image_similarity.py",
     "chatbot/chatbot_seq2seq.py",
+    "vae/variational_autoencoder.py",
+    "imageaugmentation/image_augmentation.py",
 ]
 
 # runs the example on the CPU backend inside the test environment
