@@ -42,13 +42,28 @@ class Recommender(ZooModel):
         return np.stack([np.asarray(users, np.int32),
                          np.asarray(items, np.int32)], axis=1)
 
+    def _pair_features(self, users, items):
+        """Model input for (user, item) candidate pairs. Default: the
+        raw id matrix; models that score richer features (W&D)
+        override this with their assembly step."""
+        return self._pair_matrix(users, items)
+
+    def _candidate_range(self, count_attr: str, what: str) -> np.ndarray:
+        count = getattr(self, count_attr, None)
+        if count is None:
+            raise ValueError(
+                f"{type(self).__name__}.recommend needs explicit "
+                f"candidate_{what} (the model defines no {what} "
+                "universe)")
+        return np.arange(1, count + 1)
+
     def predict_user_item_pair(
             self, pairs: Sequence[UserItemFeature],
             batch_size: int = 1024) -> List[UserItemPrediction]:
         """(ref: Recommender.scala predictUserItemPair)."""
         users = [p.user_id for p in pairs]
         items = [p.item_id for p in pairs]
-        probs = self.predict(self._pair_matrix(users, items),
+        probs = self.predict(self._pair_features(users, items),
                              batch_size=batch_size)
         return [self._to_prediction(u, i, p)
                 for u, i, p in zip(users, items, probs)]
@@ -60,9 +75,10 @@ class Recommender(ZooModel):
         """Top-K items for one user (ref: Recommender.scala
         recommendForUser)."""
         items = np.asarray(candidate_items if candidate_items is not None
-                           else np.arange(1, self.item_count + 1), np.int32)
+                           else self._candidate_range("item_count",
+                                                      "items"), np.int32)
         users = np.full_like(items, user_id)
-        probs = self.predict(self._pair_matrix(users, items),
+        probs = self.predict(self._pair_features(users, items),
                              batch_size=batch_size)
         preds = [self._to_prediction(int(u), int(i), p)
                  for u, i, p in zip(users, items, probs)]
@@ -75,9 +91,10 @@ class Recommender(ZooModel):
                            ) -> List[UserItemPrediction]:
         """(ref: Recommender.scala recommendForItem)."""
         users = np.asarray(candidate_users if candidate_users is not None
-                           else np.arange(1, self.user_count + 1), np.int32)
+                           else self._candidate_range("user_count",
+                                                      "users"), np.int32)
         items = np.full_like(users, item_id)
-        probs = self.predict(self._pair_matrix(users, items),
+        probs = self.predict(self._pair_features(users, items),
                              batch_size=batch_size)
         preds = [self._to_prediction(int(u), int(i), p)
                  for u, i, p in zip(users, items, probs)]

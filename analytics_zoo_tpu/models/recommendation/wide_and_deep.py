@@ -141,24 +141,32 @@ class WideAndDeep(Recommender):
             hidden_layers=tuple(c["hidden_layers"]))
 
     # pair-based Recommender methods need a user/item -> feature-dict
-    # builder (the reference assembles features from DataFrame rows,
-    # ref: WideAndDeep.scala recommendForUser via assemblyFeature);
-    # without one, scoring raw id pairs would be silent garbage
-    def predict_user_item_pair(self, pairs, batch_size: int = 1024):
-        raise NotImplementedError(
-            "WideAndDeep scores feature dicts (wide/embed/indicator/"
-            "continuous); build features per (user, item) and call "
-            "predict directly")
+    # builder. The reference assembles features from DataFrame rows
+    # (ref: WideAndDeep.scala recommendForUser via assemblyFeature);
+    # here the assembly step is a pluggable function so candidates can
+    # be scored from any feature source (feature table, join, ...).
+    def set_feature_assembler(self, assembler) -> "WideAndDeep":
+        """``assembler(user_ids [N], item_ids [N]) -> feature dict``
+        (the wide/embed/indicator/continuous convention of ``fit``) --
+        the analog of the reference's assemblyFeature. Enables
+        predict_user_item_pair / recommend_for_user / recommend_for_item.
+        """
+        self._assembler = assembler
+        return self
 
-    def recommend_for_user(self, *a, **k):
-        raise NotImplementedError(
-            "WideAndDeep needs assembled features; build candidate "
-            "feature dicts and call predict")
-
-    def recommend_for_item(self, *a, **k):
-        raise NotImplementedError(
-            "WideAndDeep needs assembled features; build candidate "
-            "feature dicts and call predict")
+    def _pair_features(self, users, items):
+        """Candidate pairs -> feature dict via the assembler; the base
+        ``Recommender`` ranking methods drive this hook (W&D defines no
+        user/item universe, so those methods also require explicit
+        candidates -- see ``Recommender._candidate_range``)."""
+        if getattr(self, "_assembler", None) is None:
+            raise RuntimeError(
+                "WideAndDeep scores feature dicts; call "
+                "set_feature_assembler(fn) first (fn(user_ids, "
+                "item_ids) -> feature dict), or build features and "
+                "call predict directly")
+        return self._assembler(np.asarray(users, np.int32),
+                               np.asarray(items, np.int32))
 
     def _example_input(self):
         info = self.column_info

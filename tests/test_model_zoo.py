@@ -39,6 +39,48 @@ class TestWideAndDeep:
         hist = m.fit((x, y), batch_size=64, epochs=5)
         assert hist[-1]["loss"] < hist[0]["loss"]
 
+    def test_recommend_with_feature_assembler(self):
+        """recommendForUser parity via a pluggable assembler (the
+        reference's assemblyFeature role)."""
+        x, y = self.make_data()
+        m = WideAndDeep("wide_n_deep", class_num=3,
+                        column_info=self.info())
+        m.compile(optimizer=Adam(1e-2))
+        m.fit((x, y), batch_size=64, epochs=2)
+
+        def assembler(users, items):
+            n = len(users)
+            rng = np.random.RandomState(0)
+            return {
+                "wide": np.stack([users % 10 + 1, items % 10 + 1],
+                                 axis=1).astype(np.int32),
+                "embed": np.stack([users % 10, items % 10],
+                                  axis=1).astype(np.int32),
+                "continuous": rng.randn(n, 3).astype(np.float32),
+            }
+
+        # without an assembler the failure names the fix
+        with pytest.raises(RuntimeError, match="set_feature_assembler"):
+            m.recommend_for_user(1, 3, candidate_items=[1, 2, 3])
+        m.set_feature_assembler(assembler)
+        recs = m.recommend_for_user(1, 3,
+                                    candidate_items=list(range(1, 9)))
+        assert len(recs) == 3
+        probs = [r.probability for r in recs]
+        assert probs == sorted(probs, reverse=True)
+        assert all(r.user_id == 1 for r in recs)
+        recs_i = m.recommend_for_item(2, 2,
+                                      candidate_users=list(range(1, 6)))
+        assert len(recs_i) == 2 and all(r.item_id == 2 for r in recs_i)
+        from analytics_zoo_tpu.models.recommendation.base import (
+            UserItemFeature)
+
+        pairs = [UserItemFeature(1, 2), UserItemFeature(3, 4)]
+        preds = m.predict_user_item_pair(pairs)
+        assert len(preds) == 2
+        with pytest.raises(ValueError, match="candidate_items"):
+            m.recommend_for_user(1, 3)
+
     def test_save_load(self, tmp_path):
         x, y = self.make_data()
         m = WideAndDeep("wide_n_deep", class_num=3,
