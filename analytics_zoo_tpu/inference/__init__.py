@@ -19,6 +19,9 @@ from analytics_zoo_tpu.inference.encrypt import (  # noqa: F401
     decrypt_bytes,
     encrypt_bytes,
 )
+from analytics_zoo_tpu.inference.graph_model import (  # noqa: F401
+    GraphModel,
+)
 from analytics_zoo_tpu.inference.importers import (  # noqa: F401
     import_caffe,
     import_onnx,

@@ -1,0 +1,230 @@
+"""Train imported graphs: fine-tune a frozen TF GraphDef / ONNX model
+through the Estimator.
+
+The reference's north-star interop path is not just *running* customer
+graphs but *training* them: ``TFTrainingHelper`` exposes a TF graph's
+variables to the BigDL allreduce engine (ref: zoo/src/main/scala/com/
+intel/analytics/zoo/tfpark/TFTrainingHelper.scala:33-310) and
+``TFOptimizer.from_loss/from_keras`` drives distributed fine-tuning of
+an arbitrary imported graph (ref: pyzoo/zoo/tfpark/tf_optimizer.py:
+346-747), shuttling gradients across the JVM/TF boundary every step.
+
+The TPU-native equivalent needs no bridge at all: the imported graph
+already executes as a pure jnp program (``GraphFunction``), so its
+weight constants ARE differentiable inputs -- ``jax.grad`` flows
+through the interpreter like any hand-written model. :class:`GraphModel`
+adapts a ``GraphFunction`` to the Estimator's (init, apply) contract,
+promoting the graph's floating-point weight constants to trainable
+parameters. The whole SPMD machinery (dp batch sharding, psum-inserted
+allreduce, param_spec_fn tensor sharding, checkpoints, retry) applies
+unchanged.
+
+BatchNorm caveat: a frozen graph carries batch-norm in INFERENCE form
+(moving mean/variance baked in as constants; ``FusedBatchNorm*`` /
+``BatchNormalization`` nodes normalize with stored statistics). Those
+statistics are NOT gradient-trained in the source frameworks either, so
+by default they are frozen (left as concrete constants) while the
+affine scale/offset remain trainable -- the standard "fine-tune with
+frozen BN stats" recipe. There is no update of the moving statistics
+during fine-tuning; for small-LR fine-tuning this matches the common
+``layer.trainable=False``-on-BN Keras idiom.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.inference.graph_executor import GraphFunction
+
+__all__ = ["GraphModel"]
+
+# ops whose trailing inputs are running statistics, not weights:
+# (op name) -> input positions holding mean / variance
+_BN_STAT_POSITIONS = {
+    "FusedBatchNorm": (3, 4),
+    "FusedBatchNormV2": (3, 4),
+    "FusedBatchNormV3": (3, 4),
+    "BatchNormalization": (3, 4),  # ONNX: X, scale, B, mean, var
+}
+
+
+class GraphModel:
+    """Estimator adapter over a :class:`GraphFunction`: the imported
+    graph's weight constants become the trainable ``params`` tree.
+
+    Usage::
+
+        fn = load_tf_frozen_graph("model.pb")
+        est = Estimator(GraphModel(fn), loss="sparse_categorical_...")
+        est.fit(data, batch_size=32)          # fine-tunes the graph
+
+    Args:
+      fn: an imported :class:`GraphFunction` (TF or ONNX).
+      trainable: restrict which weight constants train. A callable
+        ``name -> bool``, or an iterable of names. Untrainable weights
+        stay at their imported values (still part of the forward).
+      freeze_batchnorm_stats: keep batch-norm running mean/variance
+        constants out of ``params`` (default True; see module note).
+      output: for multi-output graphs, the output to train on -- an
+        output name or positional index. Single-output graphs ignore it.
+    """
+
+    def __init__(self, fn: GraphFunction,
+                 trainable: Union[Callable[[str], bool],
+                                  Iterable[str], None] = None,
+                 freeze_batchnorm_stats: bool = True,
+                 output: Union[str, int, None] = None):
+        self.fn = fn
+        self._out_idx = self._resolve_output(fn, output)
+        frozen = (self._batchnorm_stat_names(fn)
+                  if freeze_batchnorm_stats else set())
+        weights = {n: w for n, w in fn.weight_constants().items()
+                   if n not in frozen}
+        if trainable is not None:
+            if callable(trainable):
+                keep = {n for n in weights if trainable(n)}
+            else:
+                keep = set(trainable)
+                unknown = keep - set(weights)
+                if unknown:
+                    raise ValueError(
+                        f"trainable names not found among the graph's "
+                        f"weight constants: {sorted(unknown)}")
+            weights = {n: w for n, w in weights.items() if n in keep}
+        if not weights:
+            raise ValueError(
+                "imported graph has no trainable weight constants "
+                "(all floating-point constants are frozen or the graph "
+                "carries no weights)")
+        self._init_weights = {n: np.asarray(w) for n, w in weights.items()}
+
+    @staticmethod
+    def _resolve_output(fn: GraphFunction, output) -> Optional[int]:
+        if len(fn.output_names) <= 1:
+            return None
+        if output is None:
+            return 0
+        if isinstance(output, int):
+            return output
+        if output in fn.output_names:
+            return fn.output_names.index(output)
+        raise ValueError(f"output {output!r} not among graph outputs "
+                         f"{fn.output_names}")
+
+    @staticmethod
+    def _batchnorm_stat_names(fn: GraphFunction) -> set:
+        """Constant names holding batch-norm running statistics, frozen
+        during fine-tuning. Covers the fused node forms (FusedBatchNorm*,
+        ONNX BatchNormalization: stats at input slots 3/4) and the
+        decomposed inference form modern freezing emits
+        (``y = x*g*rsqrt(var+eps) + (beta - mean*g*rsqrt(var+eps))``):
+        variance is the vector constant inside ``Rsqrt(Add(var, eps))``,
+        mean the constant multiplied by that scale whose product feeds a
+        ``Sub`` (the x-branch product feeds the final Add instead)."""
+        stats = set()
+        for node in fn.nodes:
+            positions = _BN_STAT_POSITIONS.get(node.op)
+            if not positions:
+                continue
+            for pos in positions:
+                if pos < len(node.inputs) and node.inputs[pos]:
+                    name = node.inputs[pos][0]
+                    if name in fn.constants:
+                        stats.add(name)
+
+        consts = fn.constants
+        produced: Dict[str, Any] = {}
+        consumers: Dict[str, list] = {}
+        for node in fn.nodes:
+            for out in (node.outputs or (node.name,)):
+                if out:
+                    produced[out] = node
+            for dep in node.inputs:
+                if dep:
+                    consumers.setdefault(dep[0], []).append(node)
+
+        def _out(node):
+            return node.outputs[0] if node.outputs else node.name
+
+        def _const_source(name):
+            """Resolve through Identity chains to the underlying
+            constant name (frozen graphs wrap every variable constant in
+            a ReadVariableOp Identity)."""
+            seen = set()
+            while name not in consts:
+                node = produced.get(name)
+                if (node is None or node.op != "Identity"
+                        or not node.inputs or not node.inputs[0]
+                        or name in seen):
+                    return None
+                seen.add(name)
+                name = node.inputs[0][0]
+            return name
+
+        def _is_vec(name):
+            name = _const_source(name)
+            return (name is not None
+                    and np.asarray(consts[name]).ndim >= 1
+                    and np.issubdtype(np.asarray(consts[name]).dtype,
+                                      np.floating))
+
+        for node in fn.nodes:
+            if node.op != "Rsqrt" or not node.inputs or not node.inputs[0]:
+                continue
+            add = produced.get(node.inputs[0][0])
+            if add is None or add.op not in ("Add", "AddV2"):
+                continue
+            ins = [d[0] for d in add.inputs if d]
+            vecs = [n for n in ins if _is_vec(n)]
+            scalars = [n for n in ins
+                       if _const_source(n) is not None
+                       and np.asarray(consts[_const_source(n)]).ndim == 0]
+            if len(vecs) != 1 or len(scalars) != 1:
+                continue
+            stats.add(_const_source(vecs[0]))  # the variance
+            # rsqrt -> Mul (by gamma) = scale; Mul(mean, scale) -> Sub
+            for mul in consumers.get(_out(node), []):
+                if mul.op != "Mul":
+                    continue
+                for mul2 in consumers.get(_out(mul), []):
+                    if mul2.op != "Mul":
+                        continue
+                    if not any(c.op == "Sub"
+                               for c in consumers.get(_out(mul2), [])):
+                        continue
+                    for dep in mul2.inputs:
+                        if dep and dep[0] != _out(mul) and _is_vec(dep[0]):
+                            stats.add(_const_source(dep[0]))  # the mean
+        return stats
+
+    @property
+    def trainable_names(self):
+        return sorted(self._init_weights)
+
+    # -------------------------------------------- Estimator contract --
+    def init(self, rng, x) -> Dict[str, Any]:
+        """Imported weights ARE the initialization; rng/x unused (kept
+        for the adapter signature)."""
+        del rng, x
+        return {"params": dict(self._init_weights)}
+
+    def apply(self, variables, x, training: bool, rng=None):
+        del training, rng  # imported graphs run in inference form
+        feed = self._feed(x)
+        out = self.fn.execute(feed, constants=variables["params"])
+        if self._out_idx is not None and isinstance(out, tuple):
+            out = out[self._out_idx]
+        return out, {k: v for k, v in variables.items() if k != "params"}
+
+    def _feed(self, x) -> Dict[str, Any]:
+        names = self.fn.input_names
+        if isinstance(x, dict):
+            return dict(x)
+        parts = x if isinstance(x, tuple) else (x,)
+        if len(parts) != len(names):
+            raise ValueError(
+                f"graph expects {len(names)} inputs {names}, "
+                f"got {len(parts)}")
+        return dict(zip(names, parts))

@@ -19,6 +19,15 @@ from analytics_zoo_tpu.ml.gbt import (
 ColSpec = Union[str, Sequence[str]]
 
 
+def _have_xgboost() -> bool:
+    try:
+        import xgboost  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
 def _features(df, cols: ColSpec) -> np.ndarray:
     names = [cols] if isinstance(cols, str) else list(cols)
     parts = []
@@ -56,7 +65,17 @@ class _XGBEstimatorBase:
     def fit(self, df) -> "XGBModel":
         x = _features(df, self.features_col)
         y = np.asarray(df[self.label_col].tolist())
-        if self._classifier:
+        if _have_xgboost():
+            from xgboost.sklearn import XGBClassifier as _RealC
+            from xgboost.sklearn import XGBRegressor as _RealR
+
+            if self._classifier:
+                model = _RealC(**self.params)
+                model.fit(x, y.astype(np.int64))
+            else:
+                model = _RealR(**self.params)
+                model.fit(x, y.astype(np.float32))
+        elif self._classifier:
             num_class = int(y.max()) + 1
             model = GBTClassifier(num_class=num_class, **self.params)
             model.fit(x, y.astype(np.int64))
@@ -81,9 +100,11 @@ class XGBRegressor(_XGBEstimatorBase):
 
 class XGBModel:
     """Transformer: adds ``prediction_col`` (ref: XGBClassifierModel /
-    XGBRegressorModel transform)."""
+    XGBRegressorModel transform). ``model`` is either a real xgboost
+    sklearn model or a framework :class:`GradientBoostedTrees`; both
+    expose predict/predict_proba."""
 
-    def __init__(self, model: GradientBoostedTrees,
+    def __init__(self, model,
                  features_col: ColSpec = "features",
                  prediction_col: str = "prediction"):
         self.model = model
@@ -110,15 +131,31 @@ class XGBModel:
 
     # ----------------------------------------------------- persistence --
     def save(self, path: str) -> None:
-        p = path if path.endswith(".json") \
-            else os.path.join(path, "gbt.json")
-        self.model.save(p)
+        if isinstance(self.model, GradientBoostedTrees):
+            p = path if path.endswith(".json") \
+                else os.path.join(path, "gbt.json")
+            self.model.save(p)
+        else:  # real xgboost model
+            p = path if path.endswith(".json") \
+                else os.path.join(path, "xgb.json")
+            os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+            self.model.save_model(p)
 
     @classmethod
     def load(cls, path: str, features_col: ColSpec = "features",
              prediction_col: str = "prediction") -> "XGBModel":
-        p = (os.path.join(path, "gbt.json")
-             if os.path.isdir(path) else path)
-        return cls(GradientBoostedTrees.load(p),
-                   features_col=features_col,
+        if os.path.isdir(path):
+            xgb_p = os.path.join(path, "xgb.json")
+            p = xgb_p if os.path.exists(xgb_p) \
+                else os.path.join(path, "gbt.json")
+        else:
+            p = path
+        if p.endswith("xgb.json"):
+            from xgboost.sklearn import XGBModel as _RealBase
+
+            model = _RealBase()
+            model.load_model(p)
+        else:
+            model = GradientBoostedTrees.load(p)
+        return cls(model, features_col=features_col,
                    prediction_col=prediction_col)

@@ -128,16 +128,28 @@ def stop(name: str, state_dir: Optional[str] = None,
         state = json.load(f)
     pid = state.get("pid", 0)
     stopped = False
-    if _alive(pid):
-        os.kill(pid, signal.SIGTERM)
-        deadline = time.time() + grace_s
-        while _alive(pid) and time.time() < deadline:
-            time.sleep(0.1)
+    try:
+        # the process can exit (or its pid be recycled to another
+        # user's process, where _alive's PermissionError reads as True)
+        # between the liveness check and the kill -- either way the
+        # deployment is gone; always fall through to state-file removal
         if _alive(pid):
-            os.kill(pid, signal.SIGKILL)
-        stopped = True
-        logger.info("stopped deployment %s (pid %d)", name, pid)
-    os.unlink(path)
+            os.kill(pid, signal.SIGTERM)
+            deadline = time.time() + grace_s
+            while _alive(pid) and time.time() < deadline:
+                time.sleep(0.1)
+            if _alive(pid):
+                os.kill(pid, signal.SIGKILL)
+            stopped = True
+            logger.info("stopped deployment %s (pid %d)", name, pid)
+    except (ProcessLookupError, PermissionError) as e:
+        logger.info("deployment %s (pid %d) already gone or not ours: "
+                    "%s", name, pid, e)
+    finally:
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
     return stopped
 
 

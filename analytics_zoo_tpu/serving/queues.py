@@ -71,7 +71,10 @@ class MemQueue:
 
     def get(self, timeout: Optional[float] = None) -> Optional[bytes]:
         with self._cv:
-            if not self._q:
+            if timeout is None:  # shared contract: None = block forever
+                while not self._q:
+                    self._cv.wait()
+            elif not self._q:
                 self._cv.wait(timeout)
             if not self._q:
                 return None
@@ -101,7 +104,8 @@ class DirQueue:
         return True
 
     def get(self, timeout: Optional[float] = None) -> Optional[bytes]:
-        deadline = time.time() + (timeout or 0)
+        deadline = (None if timeout is None  # None = block forever
+                    else time.time() + timeout)
         while True:
             for name in sorted(os.listdir(self.path)):
                 if not name.endswith(".item"):
@@ -116,7 +120,7 @@ class DirQueue:
                     data = f.read()
                 os.unlink(claimed)
                 return data
-            if timeout is None or time.time() >= deadline:
+            if deadline is not None and time.time() >= deadline:
                 return None
             time.sleep(0.005)
 
@@ -309,9 +313,11 @@ class TcpQueue:
         return status == "K"
 
     def get(self, timeout: Optional[float] = None) -> Optional[bytes]:
-        deadline = time.monotonic() + max(0.0, timeout or 0.0)
+        deadline = (None if timeout is None  # None = block forever
+                    else time.monotonic() + max(0.0, timeout))
         while True:
-            remaining = deadline - time.monotonic()
+            remaining = (self._GET_SLICE_S if deadline is None
+                         else deadline - time.monotonic())
             wait = min(max(remaining, 0.0), self._GET_SLICE_S)
             # no blind retry on G: a re-sent request after a half-done
             # one could pop an item onto a dead connection
@@ -320,7 +326,7 @@ class TcpQueue:
                                          channel="get")
             if status == "K":
                 return body
-            if time.monotonic() >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:
                 return None
 
     def __len__(self) -> int:
@@ -392,6 +398,10 @@ class OutputQueue:
 
     def dequeue(self, timeout: Optional[float] = None
                 ) -> Optional[Tuple[str, Dict[str, np.ndarray]]]:
+        """Pop one result. ``timeout=None`` blocks until an item
+        arrives (uniform across memory/dir/tcp backends); ``timeout=0``
+        polls; a positive timeout waits up to that many seconds and
+        returns None on expiry."""
         blob = self._q.get(timeout)
         return None if blob is None else _decode(blob)
 

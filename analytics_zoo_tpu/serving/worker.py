@@ -89,8 +89,10 @@ class ServingWorker:
         self.served = 0
         # reply-to routing for brokered deployments: requests may name
         # the result stream of the frontend that issued them; results
-        # go there instead of the default output queue
-        self._reply_of: Dict[str, str] = {}
+        # go there instead of the default output queue. A deque per uri:
+        # clients choose their own uris, so two in-flight requests may
+        # reuse one -- routes consume FIFO, matching processing order
+        self._reply_of: Dict[str, collections.deque] = {}
         self._reply_queues: Dict[str, Any] = {}
         # dispatch pipelining: keep up to pipeline_depth batches in
         # flight (predict_async), so batch n+1's host->device transfer
@@ -116,7 +118,8 @@ class ServingWorker:
                     uri, tensors, reply = _decode_full(b)
                     items.append((uri, tensors))
                     if reply:
-                        self._reply_of[uri] = reply
+                        self._reply_of.setdefault(
+                            uri, collections.deque()).append(reply)
                 except Exception as e:  # malformed blob: drop, keep serving
                     logger.exception("serving: undecodable request "
                                      "dropped: %s", e)
@@ -184,7 +187,7 @@ class ServingWorker:
             logger.exception("serving finalize failed (results for %d "
                              "requests lost): %s", len(uris), e)
             for uri in uris:  # no leak: reply routes die with results
-                self._reply_of.pop(uri, None)
+                self._pop_reply(uri)
             return len(uris)
 
     def _finalize_inner(self, uris, preds, n) -> int:
@@ -214,8 +217,18 @@ class ServingWorker:
                     self._push_error(uri, str(e))
         return len(uris)
 
+    def _pop_reply(self, uri: str) -> Optional[str]:
+        """Consume the oldest reply route registered for ``uri``."""
+        q = self._reply_of.get(uri)
+        if not q:
+            return None
+        reply = q.popleft()
+        if not q:
+            del self._reply_of[uri]
+        return reply
+
     def _push(self, uri: str, tensors: Dict[str, np.ndarray]) -> None:
-        backend = self._reply_backend(self._reply_of.pop(uri, None))
+        backend = self._reply_backend(self._pop_reply(uri))
         if not backend.put(_encode(uri, tensors)):
             logger.warning("output queue full: dropping result for %s",
                            uri)

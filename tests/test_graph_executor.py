@@ -371,3 +371,159 @@ class TestONNXOptionalInputs:
         x = np.linspace(-1, 1, 8).astype(np.float32)
         got = np.asarray(fn(x))
         np.testing.assert_allclose(got, np.minimum(x, 0.5))
+
+
+class TestGraphModelTraining:
+    """Fine-tuning imported graphs: the TFPark training role
+    (TFTrainingHelper.scala:33-310, tf_optimizer.py:346-747) via
+    jax.grad through the jnp interpreter."""
+
+    def _randomized_cnn(self):
+        """Keras CNN with every weight randomized so value-matching
+        between frozen-graph constants and keras variables is unique
+        (fresh Conv bias and BN beta are both zeros of the same shape)."""
+        keras = tf.keras
+        model = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+            keras.layers.BatchNormalization(),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(5),
+        ])
+        rng = np.random.RandomState(7)
+        for var in model.weights:
+            w = rng.randn(*var.shape).astype(np.float32) * 0.5
+            if "variance" in var.name:
+                w = np.abs(w) + 0.5  # keep rsqrt(var + eps) real
+            var.assign(w)
+        return model
+
+    def test_tf_gradient_parity(self):
+        """One-step gradient parity vs TF's own gradients, BN in
+        inference form (moving stats frozen on both sides)."""
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.inference.graph_model import GraphModel
+
+        model = self._randomized_cnn()
+        x = np.random.RandomState(8).randn(4, 8, 8, 3).astype(np.float32)
+        y = np.random.RandomState(9).randn(4, 5).astype(np.float32)
+        with tf.GradientTape() as tape:
+            pred = model(x, training=False)
+            tf_loss = tf.reduce_mean((pred - y) ** 2)
+        tf_grads = tape.gradient(tf_loss, model.trainable_variables)
+
+        gd, ins, outs = _freeze_keras(model, x)
+        gm = GraphModel(load_tf_frozen_graph(gd, inputs=ins,
+                                             outputs=outs))
+        params = gm.init(None, x)["params"]
+
+        def loss_fn(p):
+            preds, _ = gm.apply({"params": p}, x, training=True)
+            return jnp.mean((preds - jnp.asarray(y)) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        np.testing.assert_allclose(float(loss), float(tf_loss),
+                                   rtol=1e-5, atol=1e-6)
+        checked = 0
+        for var, g in zip(model.trainable_variables, tf_grads):
+            v = var.numpy()
+            matches = [n for n, w in params.items()
+                       if np.asarray(w).shape == v.shape
+                       and np.allclose(np.asarray(w), v, atol=1e-6)]
+            assert len(matches) == 1, (var.name, matches)
+            np.testing.assert_allclose(
+                np.asarray(grads[matches[0]]), g.numpy(),
+                rtol=1e-3, atol=1e-5, err_msg=var.name)
+            checked += 1
+        assert checked == len(model.trainable_variables) == 6
+
+    def test_bn_stats_frozen_but_affine_trains(self):
+        from analytics_zoo_tpu.inference.graph_model import GraphModel
+
+        model = self._randomized_cnn()
+        x = np.random.RandomState(10).randn(2, 8, 8, 3).astype(np.float32)
+        gd, ins, outs = _freeze_keras(model, x)
+        fn = load_tf_frozen_graph(gd, inputs=ins, outputs=outs)
+        gm = GraphModel(fn)
+        # 4 trainable: conv kernel+bias, BN gamma+beta, dense kernel+bias
+        assert len(gm.trainable_names) == 6
+        stats = GraphModel._batchnorm_stat_names(fn)
+        assert len(stats) == 2  # moving mean + variance
+        assert not stats & set(gm.trainable_names)
+
+    def test_estimator_fit_drops_loss(self):
+        """Import a frozen CNN, fine-tune through the full Estimator
+        dp path; loss must drop and predictions must move."""
+        from analytics_zoo_tpu.inference.graph_model import GraphModel
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        model = self._randomized_cnn()
+        rng = np.random.RandomState(11)
+        x = rng.randn(32, 8, 8, 3).astype(np.float32)
+        y = rng.randn(32, 5).astype(np.float32)
+        gd, ins, outs = _freeze_keras(model, x)
+        gm = GraphModel(load_tf_frozen_graph(gd, inputs=ins,
+                                             outputs=outs))
+        before = np.asarray(gm.apply(gm.init(None, x), x, False)[0])
+        est = Estimator(gm, loss="mse", optimizer="adam")
+        hist = est.fit((x, y), batch_size=8, epochs=6)
+        assert hist[-1]["loss"] < hist[0]["loss"] * 0.9, hist
+        after = est.predict(x, batch_size=8)
+        assert np.abs(np.asarray(after) - before).max() > 1e-3
+
+    def test_onnx_gradient_parity_vs_torch(self):
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.inference.graph_model import GraphModel
+
+        torch.manual_seed(3)
+        m = torch.nn.Sequential(
+            torch.nn.Linear(10, 16), torch.nn.Tanh(),
+            torch.nn.Linear(16, 4),
+        )
+        x = torch.randn(6, 10)
+        t = torch.randn(6, 4)
+        loss = ((m(x) - t) ** 2).mean()
+        loss.backward()
+        sd = {k: v.detach().numpy() for k, v in m.state_dict().items()}
+        nodes = [
+            onnx_node("Gemm", ["x", "0.weight", "0.bias"], ["h"],
+                      transB=1),
+            onnx_node("Tanh", ["h"], ["a"]),
+            onnx_node("Gemm", ["a", "2.weight", "2.bias"], ["y"],
+                      transB=1),
+        ]
+        gm = GraphModel(load_onnx_model(onnx_model(nodes, sd, ["x"],
+                                                   ["y"])))
+        params = gm.init(None, x.numpy())["params"]
+
+        def loss_fn(p):
+            preds, _ = gm.apply({"params": p}, x.numpy(), training=True)
+            return jnp.mean((preds - jnp.asarray(t.numpy())) ** 2)
+
+        got_loss, grads = jax.value_and_grad(loss_fn)(params)
+        np.testing.assert_allclose(float(got_loss), float(loss),
+                                   rtol=1e-5, atol=1e-6)
+        for name, p in m.named_parameters():
+            np.testing.assert_allclose(
+                np.asarray(grads[name]), p.grad.numpy(),
+                rtol=1e-4, atol=1e-6, err_msg=name)
+
+    def test_trainable_filter_and_errors(self):
+        from analytics_zoo_tpu.inference.graph_model import GraphModel
+
+        torch.manual_seed(4)
+        m = torch.nn.Linear(6, 3)
+        sd = {k: v.detach().numpy() for k, v in m.state_dict().items()}
+        nodes = [onnx_node("Gemm", ["x", "weight", "bias"], ["y"],
+                           transB=1)]
+        fn = load_onnx_model(onnx_model(nodes, sd, ["x"], ["y"]))
+        gm = GraphModel(fn, trainable=["bias"])
+        assert gm.trainable_names == ["bias"]
+        gm2 = GraphModel(fn, trainable=lambda n: n == "weight")
+        assert gm2.trainable_names == ["weight"]
+        with pytest.raises(ValueError, match="not found"):
+            GraphModel(fn, trainable=["nope"])
