@@ -87,11 +87,17 @@ class GraphModel:
                 keep = {n for n in weights if trainable(n)}
             else:
                 keep = set(trainable)
-                unknown = keep - set(weights)
+                unknown = keep - set(fn.weight_constants())
                 if unknown:
                     raise ValueError(
                         f"trainable names not found among the graph's "
                         f"weight constants: {sorted(unknown)}")
+                frozen_named = keep & frozen
+                if frozen_named:
+                    raise ValueError(
+                        f"{sorted(frozen_named)} are batch-norm running "
+                        "statistics, frozen by default; pass "
+                        "freeze_batchnorm_stats=False to train them")
             weights = {n: w for n, w in weights.items() if n in keep}
         if not weights:
             raise ValueError(
@@ -107,6 +113,10 @@ class GraphModel:
         if output is None:
             return 0
         if isinstance(output, int):
+            if not -len(fn.output_names) <= output < len(fn.output_names):
+                raise ValueError(
+                    f"output index {output} out of range for graph "
+                    f"outputs {fn.output_names}")
             return output
         if output in fn.output_names:
             return fn.output_names.index(output)
@@ -124,16 +134,6 @@ class GraphModel:
         mean the constant multiplied by that scale whose product feeds a
         ``Sub`` (the x-branch product feeds the final Add instead)."""
         stats = set()
-        for node in fn.nodes:
-            positions = _BN_STAT_POSITIONS.get(node.op)
-            if not positions:
-                continue
-            for pos in positions:
-                if pos < len(node.inputs) and node.inputs[pos]:
-                    name = node.inputs[pos][0]
-                    if name in fn.constants:
-                        stats.add(name)
-
         consts = fn.constants
         produced: Dict[str, Any] = {}
         consumers: Dict[str, list] = {}
@@ -169,6 +169,18 @@ class GraphModel:
                     and np.asarray(consts[name]).ndim >= 1
                     and np.issubdtype(np.asarray(consts[name]).dtype,
                                       np.floating))
+
+        # fused node forms -- stats arrive via '/read' Identity
+        # wrappers in classic frozen graphs, so resolve the chain
+        for node in fn.nodes:
+            positions = _BN_STAT_POSITIONS.get(node.op)
+            if not positions:
+                continue
+            for pos in positions:
+                if pos < len(node.inputs) and node.inputs[pos]:
+                    name = _const_source(node.inputs[pos][0])
+                    if name is not None:
+                        stats.add(name)
 
         for node in fn.nodes:
             if node.op != "Rsqrt" or not node.inputs or not node.inputs[0]:

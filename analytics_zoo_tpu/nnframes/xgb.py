@@ -144,18 +144,40 @@ class XGBModel:
     @classmethod
     def load(cls, path: str, features_col: ColSpec = "features",
              prediction_col: str = "prediction") -> "XGBModel":
+        import json
+        import re
+
         if os.path.isdir(path):
             xgb_p = os.path.join(path, "xgb.json")
             p = xgb_p if os.path.exists(xgb_p) \
                 else os.path.join(path, "gbt.json")
         else:
             p = path
-        if p.endswith("xgb.json"):
-            from xgboost.sklearn import XGBModel as _RealBase
-
-            model = _RealBase()
-            model.load_model(p)
-        else:
+        # dispatch on CONTENT, not filename: the framework format
+        # carries a top-level "meta" section, the xgboost format a
+        # "learner". Sniff the leading bytes only -- a large tree
+        # ensemble should not be JSON-parsed twice just to dispatch.
+        with open(p) as f:
+            head = f.read(4096)
+        hits = {k: m.start() for k, m in
+                ((k, re.search(f'"{k}"', head)) for k in
+                 ("meta", "learner")) if m}
+        if hits.get("meta", 1 << 30) < hits.get("learner", 1 << 30):
             model = GradientBoostedTrees.load(p)
+        else:
+            from xgboost.sklearn import XGBClassifier as _RealC
+            from xgboost.sklearn import XGBRegressor as _RealR
+
+            m = re.search(r'"name"\s*:\s*"((?:multi|binary):[^"]*)"',
+                          head)
+            if m is None:  # objective may sit past the sniffed prefix
+                with open(p) as f:
+                    objective = (json.load(f).get("learner", {})
+                                 .get("objective", {}).get("name", ""))
+            else:
+                objective = m.group(1)
+            classifier = objective.startswith(("multi:", "binary:"))
+            model = _RealC() if classifier else _RealR()
+            model.load_model(p)
         return cls(model, features_col=features_col,
                    prediction_col=prediction_col)

@@ -135,12 +135,12 @@ def stop(name: str, state_dir: Optional[str] = None,
         # deployment is gone; always fall through to state-file removal
         if _alive(pid):
             os.kill(pid, signal.SIGTERM)
-            deadline = time.time() + grace_s
+            stopped = True  # the TERM landed: this call stopped it even
+            deadline = time.time() + grace_s  # if a later check races
             while _alive(pid) and time.time() < deadline:
                 time.sleep(0.1)
             if _alive(pid):
                 os.kill(pid, signal.SIGKILL)
-            stopped = True
             logger.info("stopped deployment %s (pid %d)", name, pid)
     except (ProcessLookupError, PermissionError) as e:
         logger.info("deployment %s (pid %d) already gone or not ours: "

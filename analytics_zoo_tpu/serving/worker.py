@@ -89,10 +89,10 @@ class ServingWorker:
         self.served = 0
         # reply-to routing for brokered deployments: requests may name
         # the result stream of the frontend that issued them; results
-        # go there instead of the default output queue. A deque per uri:
-        # clients choose their own uris, so two in-flight requests may
-        # reuse one -- routes consume FIFO, matching processing order
-        self._reply_of: Dict[str, collections.deque] = {}
+        # go there instead of the default output queue. The route
+        # travels WITH the request through grouping/finalize (clients
+        # choose their own uris, so a uri-keyed side table would
+        # cross-route same-uri requests that grouping reorders)
         self._reply_queues: Dict[str, Any] = {}
         # dispatch pipelining: keep up to pipeline_depth batches in
         # flight (predict_async), so batch n+1's host->device transfer
@@ -112,14 +112,12 @@ class ServingWorker:
             self.served += n
             return n
         with self.timer.timing("decode", batch=len(blobs)):
-            items: List[Tuple[str, Dict[str, np.ndarray]]] = []
+            items: List[Tuple[str, Dict[str, np.ndarray],
+                              Optional[str]]] = []
             for b in blobs:
                 try:
                     uri, tensors, reply = _decode_full(b)
-                    items.append((uri, tensors))
-                    if reply:
-                        self._reply_of.setdefault(
-                            uri, collections.deque()).append(reply)
+                    items.append((uri, tensors, reply))
                 except Exception as e:  # malformed blob: drop, keep serving
                     logger.exception("serving: undecodable request "
                                      "dropped: %s", e)
@@ -131,8 +129,8 @@ class ServingWorker:
             except Exception as e:  # input_fn/output_fn bugs must not
                 logger.exception(  # kill the serving thread
                     "serving batch failed: %s", e)
-                for uri, _ in group:
-                    self._push_error(uri, str(e))
+                for uri, _, reply in group:
+                    self._push_error(uri, reply, str(e))
                 n += len(group)
         # finalize the oldest in-flight batches beyond the pipeline
         # depth (idle cycles drain the rest -- see the early return)
@@ -147,17 +145,18 @@ class ServingWorker:
         stack into one device batch (ref: batchInput groups by model
         signature implicitly -- one model, one schema)."""
         groups: Dict[Any, List] = {}
-        for uri, tensors in items:
+        for uri, tensors, reply in items:
             sig = tuple(sorted((k, v.shape, str(v.dtype))
                                for k, v in tensors.items()))
-            groups.setdefault(sig, []).append((uri, tensors))
+            groups.setdefault(sig, []).append((uri, tensors, reply))
         return list(groups.values())
 
     def _predict_group(self, group) -> int:
-        uris = [u for u, _ in group]
+        uris = [u for u, _, _ in group]
+        replies = [r for _, _, r in group]
         with self.timer.timing("stack", batch=len(group)):
             stacked = {
-                k: np.stack([t[k] for _, t in group])
+                k: np.stack([t[k] for _, t, _ in group])
                 for k in group[0][1]
             }
             x = self.input_fn(stacked)
@@ -169,10 +168,10 @@ class ServingWorker:
                     preds, n = self.model.predict(x), len(group)
         except Exception as e:  # push per-request errors, keep serving
             logger.exception("serving predict failed: %s", e)
-            for uri in uris:
-                self._push_error(uri, str(e))
+            for uri, reply in zip(uris, replies):
+                self._push_error(uri, reply, str(e))
             return len(group)
-        self._inflight.append((uris, preds, n))
+        self._inflight.append((uris, replies, preds, n))
         return 0  # counted when finalized
 
     def _finalize_one(self) -> int:
@@ -180,17 +179,15 @@ class ServingWorker:
         (async dispatch errors surface here). Never raises: push-path
         failures (broker down, spool disk full) must not kill the
         serving loop -- callers sit outside the batch guard."""
-        uris, preds, n = self._inflight.popleft()
+        uris, replies, preds, n = self._inflight.popleft()
         try:
-            return self._finalize_inner(uris, preds, n)
+            return self._finalize_inner(uris, replies, preds, n)
         except Exception as e:
             logger.exception("serving finalize failed (results for %d "
                              "requests lost): %s", len(uris), e)
-            for uri in uris:  # no leak: reply routes die with results
-                self._pop_reply(uri)
             return len(uris)
 
-    def _finalize_inner(self, uris, preds, n) -> int:
+    def _finalize_inner(self, uris, replies, preds, n) -> int:
         import jax
 
         try:
@@ -199,36 +196,27 @@ class ServingWorker:
                     lambda a: np.asarray(a)[:n], preds)
         except Exception as e:
             logger.exception("serving predict failed: %s", e)
-            for uri in uris:
-                self._push_error(uri, str(e))
+            for uri, reply in zip(uris, replies):
+                self._push_error(uri, reply, str(e))
             return len(uris)
         with self.timer.timing("postprocess", batch=len(uris)):
-            for i, uri in enumerate(uris):
+            for i, (uri, reply) in enumerate(zip(uris, replies)):
                 try:
                     pred_i = _tree_index(preds, i)
                     if self.top_n is not None:
                         pred_i = _top_n(np.asarray(pred_i), self.top_n)
-                        self._push(uri, pred_i)
+                        self._push(uri, reply, pred_i)
                     else:
-                        self._push(uri, self.output_fn(pred_i))
+                        self._push(uri, reply, self.output_fn(pred_i))
                 except Exception as e:  # output_fn bugs must not kill
                     logger.exception(  # the serving thread
                         "serving postprocess failed for %s: %s", uri, e)
-                    self._push_error(uri, str(e))
+                    self._push_error(uri, reply, str(e))
         return len(uris)
 
-    def _pop_reply(self, uri: str) -> Optional[str]:
-        """Consume the oldest reply route registered for ``uri``."""
-        q = self._reply_of.get(uri)
-        if not q:
-            return None
-        reply = q.popleft()
-        if not q:
-            del self._reply_of[uri]
-        return reply
-
-    def _push(self, uri: str, tensors: Dict[str, np.ndarray]) -> None:
-        backend = self._reply_backend(self._pop_reply(uri))
+    def _push(self, uri: str, reply: Optional[str],
+              tensors: Dict[str, np.ndarray]) -> None:
+        backend = self._reply_backend(reply)
         if not backend.put(_encode(uri, tensors)):
             logger.warning("output queue full: dropping result for %s",
                            uri)
@@ -245,10 +233,11 @@ class ServingWorker:
                 f"tcp://{default._host}:{default._port}", name=reply_to)
         return self._reply_queues[reply_to]
 
-    def _push_error(self, uri: str, message: str) -> None:
+    def _push_error(self, uri: str, reply: Optional[str],
+                    message: str) -> None:
         # reserved out-of-band key (the "__uri__" convention of
         # queues._encode) so model outputs named "error" stay usable
-        self._push(uri, {ERROR_KEY: np.asarray(message)})
+        self._push(uri, reply, {ERROR_KEY: np.asarray(message)})
 
     def run(self, max_batches: Optional[int] = None,
             wait_timeout: float = 0.05) -> int:
