@@ -90,3 +90,96 @@ def maximum(tensors: Sequence):
 
 def dot(tensors: Sequence, axes: int = -1):
     return Merge(mode="dot", dot_axes=axes)(list(tensors))
+
+
+class _MMModule(nn.Module):
+    trans_a: bool
+    trans_b: bool
+
+    @nn.compact
+    def __call__(self, xs, train: bool = False):
+        if not isinstance(xs, (list, tuple)) or len(xs) != 2:
+            raise ValueError("MM expects exactly two input tensors")
+        a, b = xs
+        if a.ndim not in (2, 3) or b.ndim not in (2, 3):
+            raise ValueError(
+                f"MM inputs must be 2D or 3D, got {a.ndim}D and {b.ndim}D")
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class MM(KerasLayer):
+    """Matrix multiply of a two-tensor table, with optional transposes;
+    2D inputs multiply directly, 3D inputs batch-multiply
+    (ref: zoo/.../keras/layers/InternalMM.scala:37-150 -- there a Table
+    module with hand-written backward; here one jnp.matmul, with the
+    transposes folded into the same XLA dot)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+
+    def _make_module(self):
+        return _MMModule(trans_a=self.trans_a, trans_b=self.trans_b)
+
+
+class _SelectTableModule(nn.Module):
+    index: int
+
+    @nn.compact
+    def __call__(self, xs, train: bool = False):
+        if not isinstance(xs, (list, tuple)):
+            raise ValueError("SelectTable expects a table (list) input")
+        return xs[self.index]
+
+
+class SelectTable(KerasLayer):
+    """Select element ``index`` (0-based) from a table input -- either a
+    list of graph tensors or the output of :class:`SplitTensor`
+    (ref: zoo/.../keras/layers/SelectTable.scala:42-60)."""
+
+    def __init__(self, index: int, **kwargs):
+        super().__init__(**kwargs)
+        self.index = index
+
+    def _make_module(self):
+        return _SelectTableModule(index=self.index)
+
+
+class _SplitTensorModule(nn.Module):
+    dimension: int
+    num: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if not 0 <= self.dimension < x.ndim - 1:
+            raise ValueError(
+                f"dimension must be in [0, {x.ndim - 2}] (0-based, "
+                f"batch dim excluded), got {self.dimension}")
+        axis = self.dimension + 1  # input dims exclude the batch dim
+        if x.shape[axis] % self.num:
+            raise ValueError(
+                f"dimension {self.dimension} (size {x.shape[axis]}) not "
+                f"divisible into {self.num} chunks")
+        return tuple(jnp.split(x, self.num, axis=axis))
+
+
+class SplitTensor(KerasLayer):
+    """Split a tensor into a ``num``-element table along ``dimension``
+    (0-based, batch dim excluded -- the reference's convention,
+    ref: zoo/.../keras/layers/SplitTensor.scala:39-58 /
+    InternalSplitTensor.scala:27). Pair with :class:`SelectTable` to
+    route table elements through a branching graph."""
+
+    def __init__(self, dimension: int, num: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dimension = dimension
+        self.num = num
+
+    def _make_module(self):
+        return _SplitTensorModule(dimension=self.dimension, num=self.num)

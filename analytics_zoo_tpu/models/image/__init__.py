@@ -6,6 +6,11 @@ from analytics_zoo_tpu.models.image.resnet import (  # noqa: F401
     ResNet18,
     ResNet50,
 )
+from analytics_zoo_tpu.models.image.backbones import (  # noqa: F401
+    InceptionV1,
+    MobileNetV1,
+    VGG16,
+)
 from analytics_zoo_tpu.models.image.classifier import (  # noqa: F401
     ImageClassifier,
 )

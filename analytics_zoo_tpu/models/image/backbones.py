@@ -1,0 +1,198 @@
+"""Classic CNN backbones: Inception-v1 (GoogLeNet), MobileNet v1, VGG-16.
+
+The reference's image-classification zoo spans these families as
+pretrained load-and-predict models (ref: pyzoo/zoo/models/image/
+imageclassification/image_classifier.py -- Inception-v1/MobileNet/VGG/
+DenseNet variants listed in the model-zoo table) and ships Inception-v1
+as its flagship distributed-training example (ref: zoo/src/main/scala/
+com/intel/analytics/zoo/examples/inception/Train.scala /
+Inception.scala). Here each is a trainable flax module, channels-last,
+bf16-friendly, exposed through ``ImageClassifier``.
+
+Design notes (TPU): all three are plain conv stacks XLA maps straight
+onto the MXU; batch-norm everywhere (including the VGG variant, the
+standard modern recipe) keeps activations bf16-stable; MobileNet's
+depthwise convs use ``feature_group_count`` so XLA emits the fused
+depthwise kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _norm(train: bool, dtype):
+    return partial(nn.BatchNorm, use_running_average=not train,
+                   momentum=0.9, epsilon=1e-3, dtype=dtype)
+
+
+class InceptionBlock(nn.Module):
+    """One GoogLeNet mixed block: 1x1 | 1x1-3x3 | 1x1-5x5 | pool-1x1
+    branches concatenated on channels (ref: Inception.scala's
+    inceptionLayerV1 branch structure)."""
+
+    b1: int          # 1x1 branch filters
+    b3_reduce: int   # 3x3 branch bottleneck
+    b3: int
+    b5_reduce: int   # 5x5 branch bottleneck
+    b5: int
+    pool_proj: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(train, self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+
+        def unit(h, filters, kernel, name):
+            h = conv(filters, kernel, name=f"{name}_conv")(h)
+            return nn.relu(norm(name=f"{name}_bn")(h))
+
+        br1 = unit(x, self.b1, (1, 1), "b1")
+        br3 = unit(x, self.b3_reduce, (1, 1), "b3r")
+        br3 = unit(br3, self.b3, (3, 3), "b3")
+        br5 = unit(x, self.b5_reduce, (1, 1), "b5r")
+        br5 = unit(br5, self.b5, (5, 5), "b5")
+        brp = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        brp = unit(brp, self.pool_proj, (1, 1), "bp")
+        return jnp.concatenate([br1, br3, br5, brp], axis=-1)
+
+
+# GoogLeNet table: (b1, b3_reduce, b3, b5_reduce, b5, pool_proj)
+_INCEPTION_CFG = {
+    "3a": (64, 96, 128, 16, 32, 32),
+    "3b": (128, 128, 192, 32, 96, 64),
+    "4a": (192, 96, 208, 16, 48, 64),
+    "4b": (160, 112, 224, 24, 64, 64),
+    "4c": (128, 128, 256, 24, 64, 64),
+    "4d": (112, 144, 288, 32, 64, 64),
+    "4e": (256, 160, 320, 32, 128, 128),
+    "5a": (256, 160, 320, 32, 128, 128),
+    "5b": (384, 192, 384, 48, 128, 128),
+}
+
+
+class InceptionV1(nn.Module):
+    """GoogLeNet with batch-norm (the reference's distributed-training
+    flagship; ref: examples/inception/Inception.scala Inception_v1).
+    The train-time auxiliary heads are omitted -- they existed to aid
+    pre-BN optimization and modern BN training does not need them."""
+
+    num_classes: int = 1000
+    dropout_rate: float = 0.4
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(train, self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        x = conv(64, (7, 7), (2, 2), name="stem_conv1")(x)
+        x = nn.relu(norm(name="stem_bn1")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = conv(64, (1, 1), name="stem_conv2")(x)
+        x = nn.relu(norm(name="stem_bn2")(x))
+        x = conv(192, (3, 3), name="stem_conv3")(x)
+        x = nn.relu(norm(name="stem_bn3")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for key in ("3a", "3b"):
+            x = InceptionBlock(*_INCEPTION_CFG[key], dtype=self.dtype,
+                               name=f"mixed{key}")(x, train=train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for key in ("4a", "4b", "4c", "4d", "4e"):
+            x = InceptionBlock(*_INCEPTION_CFG[key], dtype=self.dtype,
+                               name=f"mixed{key}")(x, train=train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for key in ("5a", "5b"):
+            x = InceptionBlock(*_INCEPTION_CFG[key], dtype=self.dtype,
+                               name=f"mixed{key}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x)
+
+
+class _SeparableBlock(nn.Module):
+    """Depthwise 3x3 + pointwise 1x1, each BN-relu (MobileNet v1 unit)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(train, self.dtype)
+        channels = x.shape[-1]
+        x = nn.Conv(channels, (3, 3), self.strides, use_bias=False,
+                    feature_group_count=channels, dtype=self.dtype,
+                    name="dw_conv")(x)
+        x = nn.relu(norm(name="dw_bn")(x))
+        x = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="pw_conv")(x)
+        return nn.relu(norm(name="pw_bn")(x))
+
+
+class MobileNetV1(nn.Module):
+    """MobileNet v1 with a width multiplier (ref model-zoo family:
+    image_classifier.py "mobilenet" variants)."""
+
+    num_classes: int = 1000
+    width: float = 1.0
+    dropout_rate: float = 0.001
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def w(f):
+            return max(8, int(f * self.width))
+
+        norm = _norm(train, self.dtype)
+        x = nn.Conv(w(32), (3, 3), (2, 2), use_bias=False,
+                    dtype=self.dtype, name="stem_conv")(x)
+        x = nn.relu(norm(name="stem_bn")(x))
+        plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
+                (512, 2)] + [(512, 1)] * 5 + [(1024, 2), (1024, 1)]
+        for i, (filters, stride) in enumerate(plan):
+            x = _SeparableBlock(w(filters), (stride, stride),
+                                dtype=self.dtype,
+                                name=f"block{i + 1}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x)
+
+
+class VGG16(nn.Module):
+    """VGG-16 (configuration D) with batch-norm (ref model-zoo family:
+    image_classifier.py "vgg-16"). The giant fc6/fc7 dense layers are
+    kept at 4096 to match the family's capacity."""
+
+    num_classes: int = 1000
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(train, self.dtype)
+        plan = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+        for s, (filters, reps) in enumerate(plan):
+            for r in range(reps):
+                x = nn.Conv(filters, (3, 3), use_bias=False,
+                            dtype=self.dtype,
+                            name=f"conv{s + 1}_{r + 1}")(x)
+                x = nn.relu(norm(name=f"bn{s + 1}_{r + 1}")(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for i in (6, 7):
+            x = nn.Dense(4096, dtype=self.dtype, name=f"fc{i}")(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate,
+                           deterministic=not train)(x)
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x)

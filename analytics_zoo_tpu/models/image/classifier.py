@@ -15,9 +15,13 @@ import flax.linen as _nn
 import numpy as np
 
 from analytics_zoo_tpu.models.common import ZooModel, register_model
+from analytics_zoo_tpu.models.image.backbones import (
+    InceptionV1, MobileNetV1, VGG16)
 from analytics_zoo_tpu.models.image.resnet import ResNet18, ResNet50
 
-_BACKBONES = {"resnet18": ResNet18, "resnet50": ResNet50}
+_BACKBONES = {"resnet18": ResNet18, "resnet50": ResNet50,
+              "inception-v1": InceptionV1, "mobilenet": MobileNetV1,
+              "vgg16": VGG16}
 
 # ImageNet channel stats (the reference's ImageChannelNormalize defaults)
 _MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)

@@ -28,6 +28,7 @@ EXAMPLES = [
     "chatbot/chatbot_seq2seq.py",
     "vae/variational_autoencoder.py",
     "imageaugmentation/image_augmentation.py",
+    "inception/train_inception.py",
 ]
 
 # runs the example on the CPU backend inside the test environment

@@ -254,3 +254,71 @@ class TestImage:
         dets = detect_per_class(boxes, scores, score_threshold=0.3)
         assert len(dets) == 2  # duplicate box suppressed
         assert dets[0][0] == 1 and dets[1][0] == 2
+
+
+class TestBackboneBreadth:
+    """Inception-v1 / MobileNet / VGG-16 backbones (VERDICT round-3
+    item 5; ref: examples/inception/Train.scala and
+    pyzoo/zoo/models/image/imageclassification/image_classifier.py)."""
+
+    def test_registry_has_at_least_four(self):
+        from analytics_zoo_tpu.models.image.classifier import _BACKBONES
+
+        assert len(_BACKBONES) >= 4
+        for name in ("inception-v1", "mobilenet", "resnet50"):
+            assert name in _BACKBONES
+
+    @pytest.mark.parametrize("backbone,size", [
+        ("inception-v1", 64), ("mobilenet", 64), ("vgg16", 32)])
+    def test_forward_shape(self, backbone, size):
+        model = ImageClassifier(class_num=5, backbone=backbone,
+                                image_size=size)
+        x = np.random.RandomState(0).rand(8, size, size, 3) \
+            .astype(np.float32)
+        preds = model.predict(x, batch_size=8)
+        assert preds.shape == (8, 5)
+        assert np.isfinite(preds).all()
+
+    def test_inception_param_count_matches_googlenet(self):
+        """GoogLeNet sans aux heads is ~6.0M conv/bn parameters plus
+        the 1024->N head -- a structural golden against the published
+        architecture table."""
+        import jax
+
+        from analytics_zoo_tpu.models.image.backbones import InceptionV1
+
+        m = InceptionV1(num_classes=1000)
+        v = m.init({"params": jax.random.PRNGKey(0),
+                    "dropout": jax.random.PRNGKey(1)},
+                   np.zeros((1, 64, 64, 3), np.float32))
+        n = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(v["params"]))
+        assert 6.5e6 < n < 7.5e6, n
+        # final mixed block must emit 1024 channels (384+384+128+128)
+        head_kernel = v["params"]["head"]["kernel"]
+        assert head_kernel.shape == (1024, 1000)
+
+    def test_mobilenet_depthwise_grouping(self):
+        """Depthwise kernels must be [3, 3, 1, C] (feature_group_count
+        = channels), not full convs."""
+        import jax
+
+        from analytics_zoo_tpu.models.image.backbones import MobileNetV1
+
+        m = MobileNetV1(num_classes=3, width=0.5)
+        v = m.init({"params": jax.random.PRNGKey(0),
+                    "dropout": jax.random.PRNGKey(1)},
+                   np.zeros((1, 64, 64, 3), np.float32))
+        dw = v["params"]["block1"]["dw_conv"]["kernel"]
+        assert dw.shape == (3, 3, 1, 16)  # 32 * 0.5 width
+        pw = v["params"]["block1"]["pw_conv"]["kernel"]
+        assert pw.shape == (1, 1, 16, 32)  # -> 64 * 0.5
+
+    def test_inception_trains(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(32, 64, 64, 3).astype(np.float32)
+        y = (x[:, :8, :8, 0].mean(axis=(1, 2)) > 0.5).astype(np.int32)
+        model = ImageClassifier(class_num=2, backbone="inception-v1",
+                                image_size=64)
+        hist = model.fit((x, y), batch_size=16, epochs=2)
+        assert np.isfinite(hist[-1]["loss"])
