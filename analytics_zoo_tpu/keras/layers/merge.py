@@ -101,9 +101,10 @@ class _MMModule(nn.Module):
         if not isinstance(xs, (list, tuple)) or len(xs) != 2:
             raise ValueError("MM expects exactly two input tensors")
         a, b = xs
-        if a.ndim not in (2, 3) or b.ndim not in (2, 3):
+        if a.ndim not in (2, 3) or b.ndim != a.ndim:
             raise ValueError(
-                f"MM inputs must be 2D or 3D, got {a.ndim}D and {b.ndim}D")
+                "MM inputs must both be 2D or both be 3D, got "
+                f"{a.ndim}D and {b.ndim}D")
         if self.trans_a:
             a = jnp.swapaxes(a, -1, -2)
         if self.trans_b:

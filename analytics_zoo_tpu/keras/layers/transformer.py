@@ -21,28 +21,14 @@ import numpy as np
 from analytics_zoo_tpu.keras.layers.base import KerasLayer
 from analytics_zoo_tpu.ops.attention import dot_product_attention
 
-_ring_dropout_warned = False
-
-
-def _warn_ring_dropout_once():
-    global _ring_dropout_warned
-    if not _ring_dropout_warned:
-        _ring_dropout_warned = True
-        from analytics_zoo_tpu.common.log import get_logger
-
-        get_logger(__name__).warning(
-            "seq_axis ring attention does not support attention-prob "
-            "dropout; attn_dropout is ignored on this path (hidden "
-            "dropout still applies)")
-
-
 class MultiHeadSelfAttention(nn.Module):
     """``seq_axis``: name of a mesh axis to shard the sequence over --
-    when set (and the context mesh has that axis with size > 1, no
-    explicit mask, no attention dropout), attention runs as exact ring
-    attention over the axis (``parallel.ring_attention``), giving
-    long-context sequence parallelism inside any model built on this
-    layer. Otherwise dispatches to the flash/jnp kernels."""
+    when set (and the context mesh has that axis with size > 1 and no
+    explicit mask), attention runs as exact ring attention over the
+    axis (``parallel.ring_attention``), giving long-context sequence
+    parallelism inside any model built on this layer; attention-prob
+    dropout applies tile-wise inside the ring. Otherwise dispatches to
+    the flash/jnp kernels."""
 
     hidden_size: int
     n_head: int
@@ -80,18 +66,19 @@ class MultiHeadSelfAttention(nn.Module):
             # shard_map preconditions: both sharded dims must divide --
             # fall back to the dense path like the mask/dropout cases
             if seq_size > 1 and l % seq_size == 0 and b % data_size == 0:
-                if train and self.attn_dropout > 0:
-                    # ring (like every flash kernel) has no prob-dropout;
-                    # seq_axis is an explicit request for long context,
-                    # so keep the ring and drop this regularizer
-                    _warn_ring_dropout_once()
+                ring_rng = (self.make_rng("dropout")
+                            if train and self.attn_dropout > 0 else None)
                 # ring layout [B, L, H, D]; shard_map nests inside the
-                # outer jit and reshards q/k/v along the seq axis
+                # outer jit and reshards q/k/v along the seq axis.
+                # Prob-dropout applies tile-wise inside the ring (exact;
+                # see ring_attention's numerator-only masking)
                 out = ring_attention(
                     q.reshape(b, l, self.n_head, hd),
                     k.reshape(b, l, self.n_head, hd),
                     v.reshape(b, l, self.n_head, hd),
                     mesh, axis_name=self.seq_axis, causal=self.causal,
+                    dropout_rate=self.attn_dropout if train else 0.0,
+                    dropout_rng=ring_rng,
                 ).reshape(b, l, self.hidden_size)
         if out is None:
             def heads(t):

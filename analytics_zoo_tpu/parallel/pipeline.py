@@ -18,8 +18,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _pipeline_local(stage_params, microbatches, stage_fn, axis_name: str,
-                    n_microbatches: int):
+def _pipeline_local(stage_params, microbatches, rng, stage_fn,
+                    axis_name: str, n_microbatches: int):
     """Runs on one device holding one stage (shard_map body).
 
     stage_params: this stage's params (leading stage dim stripped by
@@ -28,6 +28,11 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name: str,
       only stage 0 reads it to inject inputs. This costs S copies of the
       microbatch buffer; acceptable because microbatches are inputs, not
       the (large) inter-stage activations, which stay per-device.
+    rng: optional base dropout key (replicated). When set, ``stage_fn``
+      receives ``(params, x, mb_idx, stage_id, rng)`` so it can fold a
+      deterministic per-(microbatch, layer) key -- the plumbing that
+      makes dropout exact-reproducible between the pipeline schedule
+      and a sequential run of the same blocks.
     """
     n_stages = lax.axis_size(axis_name)
     stage_id = lax.axis_index(axis_name)
@@ -47,7 +52,13 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name: str,
                       jnp.where(t < n_microbatches, inject,
                                 jnp.zeros_like(inject)),
                       state)
-        y = stage_fn(stage_params, x)
+        if rng is None:
+            y = stage_fn(stage_params, x)
+        else:
+            # at tick t this stage processes microbatch t - stage_id
+            # (bubble ticks compute on zeros and are never recorded)
+            mb_idx = jnp.clip(t - stage_id, 0, n_microbatches - 1)
+            y = stage_fn(stage_params, x, mb_idx, stage_id, rng)
         # last stage records its finished microbatch (t - (S-1))
         out_idx = t - (n_stages - 1)
         record = jnp.logical_and(stage_id == n_stages - 1, out_idx >= 0)
@@ -68,15 +79,17 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name: str,
     return lax.psum(outputs, axis_name)
 
 
-def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+def pipeline_apply(stage_fn: Callable[..., jnp.ndarray],
                    stacked_params: Any, microbatches: jnp.ndarray,
                    mesh: Mesh, axis_name: str = "pipe",
-                   data_axis: str = None) -> jnp.ndarray:
+                   data_axis: str = None, rng=None) -> jnp.ndarray:
     """Run ``stage_fn`` as an S-stage pipeline over the ``axis_name`` axis.
 
     Args:
       stage_fn: (stage_params, activation [*mb_shape]) -> activation; must
-        preserve the activation shape/dtype between stages.
+        preserve the activation shape/dtype between stages. With ``rng``
+        set the signature is (stage_params, activation, mb_idx, stage_id,
+        rng) -> activation (fold your per-layer dropout keys from those).
       stacked_params: pytree whose leaves have leading dim S (one slice per
         stage) -- sharded so each device gets its stage.
       microbatches: [M, *mb_shape] microbatch activations.
@@ -84,6 +97,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
       data_axis: optional mesh axis to shard the microbatch batch dim
         (``mb_shape[0]``) over -- a combined dp x pp mesh: each data
         shard runs its own pipeline over the same stage parameters.
+      rng: optional base dropout key, replicated to every stage.
 
     Returns [M, *mb_shape]: outputs of the final stage per microbatch.
     """
@@ -92,14 +106,16 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         lambda _: P(axis_name), stacked_params)
     mb_spec = (P(None, data_axis) if data_axis is not None
                and data_axis in mesh.axis_names else P())
+    extra = () if rng is None else (rng,)
+    body = partial(_pipeline_local, stage_fn=stage_fn,
+                   axis_name=axis_name, n_microbatches=n_microbatches,
+                   **({"rng": None} if rng is None else {}))
     fn = jax.shard_map(
-        partial(_pipeline_local, stage_fn=stage_fn, axis_name=axis_name,
-                n_microbatches=n_microbatches),
-        mesh=mesh,
-        in_specs=(param_specs, mb_spec),
+        body, mesh=mesh,
+        in_specs=(param_specs, mb_spec) + (P(),) * len(extra),
         out_specs=mb_spec,
         check_vma=False)
-    return fn(stacked_params, microbatches)
+    return fn(stacked_params, microbatches, *extra)
 
 
 def pipeline_train_step(stage_fn: Callable[[Any, jnp.ndarray],
@@ -126,10 +142,10 @@ def pipeline_train_step(stage_fn: Callable[[Any, jnp.ndarray],
     """
     import optax
 
-    def step(stacked_params, opt_state, microbatches, targets):
+    def step(stacked_params, opt_state, microbatches, targets, rng=None):
         def loss(params):
             out = pipeline_apply(stage_fn, params, microbatches, mesh,
-                                 axis_name, data_axis=data_axis)
+                                 axis_name, data_axis=data_axis, rng=rng)
             return loss_fn(out, targets)
 
         l, grads = jax.value_and_grad(loss)(stacked_params)

@@ -34,11 +34,20 @@ NEG_INF = -1e30
 
 
 def _block_attn(q, k, v, bias, q_offset, kv_offset, causal, scale,
-                m_prev, l_prev, o_prev):
+                m_prev, l_prev, o_prev, dropout_rate=0.0,
+                dropout_key=None):
     """One flash-attention block update with online softmax.
 
     q: [B, Lq, H, D]; k, v: [B, Lkv, H, D]; accumulators carry the running
     max ``m``, normalizer ``l`` and unnormalized output ``o``.
+
+    Attention-probability dropout composes exactly with the streaming
+    softmax: standard attention computes ``dropout(softmax(s)) @ v``,
+    whose denominator is dropout-free -- so the Bernoulli mask applies
+    only to the NUMERATOR accumulation (``p @ v``) while ``l`` keeps
+    every exp term. Each (q-block, kv-block) tile draws from its own
+    key, so every global prob element is dropped independently exactly
+    once.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if bias is not None:
@@ -55,16 +64,27 @@ def _block_attn(q, k, v, bias, q_offset, kv_offset, causal, scale,
     p = jnp.exp(s - m_new[..., None])                # [B, H, Lq, Lkv]
     l_corr = jnp.exp(m_prev - m_new)
     l_new = l_corr * l_prev + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    p_num = p
+    if dropout_key is not None and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate,
+                                    p.shape)
+        p_num = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p_num.astype(v.dtype), v)
     o_new = o_prev * l_corr.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
 
-def _ring_attn_local(q, k, v, axis_name: str, causal: bool,
-                     scale: Optional[float]):
+def _ring_attn_local(q, k, v, rng, axis_name: str, causal: bool,
+                     scale: Optional[float], dropout_rate: float = 0.0,
+                     batch_axis: Optional[str] = None):
     """Per-device body, runs under shard_map with seq-sharded q/k/v."""
     n_dev = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
+    if rng is not None and batch_axis is not None:
+        # each batch shard draws its own masks: without this fold the
+        # replicated rng would repeat one mask across data-parallel
+        # shards (correlated dropout that changes with dp degree)
+        rng = jax.random.fold_in(rng, lax.axis_index(batch_axis))
     b, lq, h, d = q.shape
     lkv = k.shape[1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
@@ -80,9 +100,15 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool,
         # K/V block currently resident came from device (idx - i) mod n
         kv_owner = (idx - i) % n_dev
         kv_offset = kv_owner * lkv
+        # key per (q-block, kv-block) tile: deterministic in the GLOBAL
+        # tile coordinates, so the mask pattern is independent of how
+        # the ring schedule visits tiles
+        key = (jax.random.fold_in(rng, idx * n_dev + kv_owner)
+               if rng is not None else None)
         m, l, o = _block_attn(q32, k_blk.astype(jnp.float32),
                               v_blk.astype(jnp.float32), None,
-                              q_offset, kv_offset, causal, scale, m, l, o)
+                              q_offset, kv_offset, causal, scale, m, l, o,
+                              dropout_rate=dropout_rate, dropout_key=key)
         perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
@@ -97,7 +123,8 @@ def _ring_attn_local(q, k, v, axis_name: str, causal: bool,
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
                    causal: bool = False, scale: Optional[float] = None,
-                   qkv_spec: Optional[P] = None):
+                   qkv_spec: Optional[P] = None,
+                   dropout_rate: float = 0.0, dropout_rng=None):
     """Exact attention with sequence dim sharded over ``axis_name``.
 
     Args:
@@ -107,16 +134,29 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
       causal: apply causal masking using global positions.
       qkv_spec: PartitionSpec for q/k/v; default shards batch over 'data'
         (if present in the mesh) and seq over ``axis_name``.
+      dropout_rate / dropout_rng: attention-probability dropout; each
+        (q-block, kv-block) tile folds its own key from ``dropout_rng``
+        so the ring schedule applies exact elementwise prob dropout
+        (see ``_block_attn``). Pass a key only when training.
     """
     if qkv_spec is None:
         data = "data" if "data" in mesh.axis_names else None
         qkv_spec = P(data, axis_name, None, None)
+    dropping = dropout_rng is not None and dropout_rate > 0.0
+    batch_axis = qkv_spec[0] if len(qkv_spec) > 0 else None
+    if not isinstance(batch_axis, str):
+        batch_axis = None
+    extra = (dropout_rng,) if dropping else ()
     fn = jax.shard_map(
         partial(_ring_attn_local, axis_name=axis_name, causal=causal,
-                scale=scale),
-        mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
+                scale=scale,
+                dropout_rate=dropout_rate if dropping else 0.0,
+                batch_axis=batch_axis if dropping else None,
+                **({} if dropping else {"rng": None})),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec) + (P(),) * len(extra),
         out_specs=qkv_spec, check_vma=False)
-    return fn(q, k, v)
+    return fn(q, k, v, *extra)
 
 
 def ring_self_attention(x, wq, wk, wv, wo, num_heads: int, mesh: Mesh,

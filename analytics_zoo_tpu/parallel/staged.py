@@ -14,10 +14,15 @@ falls back to a sequential ``lax.scan`` over the stacked blocks --
 numerically identical (the pipeline only reorders the microbatch
 schedule), which is what the parity tests assert.
 
-Dropout is deterministic-off inside the pipelined encoder (same
-trade-off as ring attention: the GPipe schedule has no per-microbatch
-rng plumbing); embeddings and any head you attach stay outside the
-pipeline and may drop out freely.
+Dropout is fully supported through the pipeline: every (microbatch,
+block) pair folds its own key from the step rng -- ``fold_in(rng,
+mb_idx * n_block + global_block)`` -- a formula independent of the
+pipeline degree, so on a pipe-only mesh the GPipe schedule and the
+sequential fallback draw IDENTICAL masks (asserted by the parity
+test). On a dp x pp mesh each data shard additionally folds its shard
+index, keeping masks i.i.d. across the batch -- per-shard draws, like
+any shard_map dropout, so bitwise parity with a differently-sharded
+run is not defined there.
 """
 
 from __future__ import annotations
@@ -76,20 +81,22 @@ class PipelinedTransformerLM:
                  n_head: int = 12, n_block: int = 12,
                  intermediate_size: Optional[int] = None,
                  causal: bool = True, n_microbatches: int = 2,
+                 hidden_dropout: float = 0.0, attn_dropout: float = 0.0,
                  dtype: Any = jnp.float32, mesh=None):
         self.vocab = vocab
         self.seq_len = seq_len
         self.hidden_size = hidden_size
         self.n_block = n_block
         self.n_microbatches = n_microbatches
+        self.dropout_on = hidden_dropout > 0 or attn_dropout > 0
         self.dtype = dtype
         self.mesh = mesh
         self._embedder = _Embedder(vocab, seq_len, hidden_size)
         self._block = TransformerBlock(
             hidden_size, n_head,
             intermediate_size or 4 * hidden_size,
-            hidden_dropout=0.0, attn_dropout=0.0, causal=causal,
-            dtype=dtype)
+            hidden_dropout=hidden_dropout, attn_dropout=attn_dropout,
+            causal=causal, dtype=dtype)
 
     # ------------------------------------------------- adapter contract --
     def init(self, rng, x) -> Dict[str, Any]:
@@ -124,24 +131,75 @@ class PipelinedTransformerLM:
         m = self.n_microbatches
         use_pipe = (pipe > 1 and self.n_block % pipe == 0
                     and b % m == 0 and (b // m) % data == 0)
+        dropout = self.dropout_on and training and rng is not None
         if use_pipe:
+            bps = self.n_block // pipe
             stage_params = jax.tree_util.tree_map(
-                lambda a: a.reshape((pipe, self.n_block // pipe)
-                                    + a.shape[1:]), blocks)
+                lambda a: a.reshape((pipe, bps) + a.shape[1:]), blocks)
             mb = h.reshape((m, b // m) + h.shape[1:])
 
-            def stage_fn(sp, a):
-                def body(carry, layer):
-                    return self._block.apply({"params": layer},
-                                             carry), None
+            if dropout:
+                n_block = self.n_block
+                data_axis = "data" if data > 1 else None
 
-                out, _ = lax.scan(body, a, sp)
-                return out
+                def stage_fn(sp, a, mb_idx, stage_id, key):
+                    if data_axis is not None:
+                        # per-data-shard masks: a replicated key would
+                        # repeat one mask across dp shards
+                        key = jax.random.fold_in(
+                            key, lax.axis_index(data_axis))
 
-            out = pipeline_apply(
-                stage_fn, stage_params, mb, mesh, axis_name="pipe",
-                data_axis="data" if data > 1 else None)
+                    def body(carry, layer_j):
+                        layer, j = layer_j
+                        k = jax.random.fold_in(
+                            key, mb_idx * n_block + stage_id * bps + j)
+                        out = self._block.apply(
+                            {"params": layer}, carry, train=True,
+                            rngs={"dropout": k})
+                        return out, None
+
+                    out, _ = lax.scan(body, a, (sp, jnp.arange(bps)))
+                    return out
+
+                out = pipeline_apply(
+                    stage_fn, stage_params, mb, mesh, axis_name="pipe",
+                    data_axis="data" if data > 1 else None, rng=rng)
+            else:
+                def stage_fn(sp, a):
+                    def body(carry, layer):
+                        return self._block.apply({"params": layer},
+                                                 carry), None
+
+                    out, _ = lax.scan(body, a, sp)
+                    return out
+
+                out = pipeline_apply(
+                    stage_fn, stage_params, mb, mesh, axis_name="pipe",
+                    data_axis="data" if data > 1 else None)
             h = out.reshape((b,) + h.shape[1:])
+        elif dropout:
+            # sequential fallback with the SAME per-(microbatch, block)
+            # key formula, so dp and pp draw identical masks. A batch
+            # the microbatch count doesn't divide degrades to one
+            # microbatch (the pipeline wouldn't engage there either).
+            n_block = self.n_block
+            if b % m != 0:
+                m = 1
+            hm = h.reshape((m, b // m) + h.shape[1:])
+
+            def body(carry, layer_j):
+                layer, j = layer_j
+
+                def per_mb(mb_h, mb_idx):
+                    k = jax.random.fold_in(rng, mb_idx * n_block + j)
+                    return self._block.apply(
+                        {"params": layer}, mb_h, train=True,
+                        rngs={"dropout": k})
+
+                return jax.vmap(per_mb)(carry, jnp.arange(m)), None
+
+            hm, _ = lax.scan(body, hm, (blocks, jnp.arange(self.n_block)))
+            h = hm.reshape((b,) + h.shape[1:])
         else:
             def body(carry, layer):
                 return self._block.apply({"params": layer}, carry), None

