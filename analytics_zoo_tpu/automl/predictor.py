@@ -62,7 +62,8 @@ class TimeSequencePredictor:
                  dt_col: str = "datetime", target_col="value",
                  extra_features_col=None, drop_missing: bool = True,
                  executor: str = "sequential",
-                 max_workers: Optional[int] = None):
+                 max_workers: Optional[int] = None,
+                 scheduler: str = "fifo"):
         self.name = name
         self.logs_dir = logs_dir
         self.future_seq_len = future_seq_len
@@ -73,6 +74,7 @@ class TimeSequencePredictor:
         self.drop_missing = drop_missing
         self.executor = executor
         self.max_workers = max_workers
+        self.scheduler = scheduler
         self.pipeline: Optional[TimeSequencePipeline] = None
 
     def _spec(self) -> Dict[str, Any]:
@@ -93,7 +95,8 @@ class TimeSequencePredictor:
 
         engine = SearchEngine(executor=self.executor,
                               max_workers=self.max_workers,
-                              logs_dir=self.logs_dir, name=self.name)
+                              logs_dir=self.logs_dir, name=self.name,
+                              scheduler=self.scheduler)
         data = {"spec": self._spec(), "train_df": input_df,
                 "validation_df": validation_df}
         engine.compile(data, time_sequence_trial, recipe=recipe,

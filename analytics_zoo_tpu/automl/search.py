@@ -86,37 +86,66 @@ class SearchEngine:
       max_workers: pool width for the process executor.
       logs_dir: when set, each trial's reward lands in a TensorBoard
         event file (ref: automl/logger/tensorboardxlogger.py).
+      scheduler: "fifo" runs every trial to its full epoch budget;
+        "asha" runs synchronous successive halving -- rung r gives every
+        surviving config ``grace_epochs * reduction_factor**r`` epochs
+        and promotes the top ``1/reduction_factor`` fraction, so the
+        search budget concentrates on promising configs (the
+        stop/scheduler role of the reference's Ray Tune path, ref:
+        pyzoo/zoo/automl/search/ray_tune_search_engine.py:56-147).
+      reduction_factor / grace_epochs: ASHA rung geometry.
     """
 
     def __init__(self, executor: str = "sequential",
                  max_workers: Optional[int] = None,
-                 logs_dir: Optional[str] = None, name: str = "automl"):
+                 logs_dir: Optional[str] = None, name: str = "automl",
+                 scheduler: str = "fifo", reduction_factor: int = 4,
+                 grace_epochs: int = 1):
         if executor not in ("sequential", "process"):
             raise ValueError("executor must be sequential|process")
+        if scheduler not in ("fifo", "asha"):
+            raise ValueError("scheduler must be fifo|asha")
+        if reduction_factor < 2:
+            raise ValueError("reduction_factor must be >= 2")
         self.executor = executor
         self.max_workers = max_workers or min(4, os.cpu_count() or 1)
         self.logs_dir = logs_dir
         self.name = name
+        self.scheduler = scheduler
+        self.reduction_factor = reduction_factor
+        self.grace_epochs = max(1, int(grace_epochs))
         self.trial_fn: Optional[Callable] = None
         self.data: Any = None
         self.configs: List[Dict[str, Any]] = []
         self.metric = "mse"
         self.mode = "min"
         self.trials: List[TrialOutput] = []
+        self.stop: Optional[Dict[str, Any]] = None
+        self.total_trial_epochs = 0
 
     # ----------------------------------------------------------- setup --
     def compile(self, data: Any, trial_fn: Callable, recipe=None,
                 search_space: Optional[Dict[str, Any]] = None,
                 feature_list: Optional[List[str]] = None,
-                metric: str = "mse", seed: int = 0) -> None:
+                metric: str = "mse", seed: int = 0,
+                stop: Optional[Dict[str, Any]] = None) -> None:
         """Freeze the trial plan (ref: RayTuneSearchEngine.compile).
 
         ``recipe`` supplies search_space(feature_list) + runtime params;
         alternatively pass an explicit ``search_space`` dict.
+
+        ``stop`` gives early-stop criteria (the tune.run ``stop`` role),
+        honored by BOTH schedulers: ``{"reward": x}`` ends the search
+        once any trial reaches x (>= x for max-mode metrics, <= x for
+        min-mode); ``{"total_epochs": n}`` stops launching work once
+        the summed trial-epochs budget reaches n (the work unit in
+        flight when the cap trips -- one trial on fifo, one rung on
+        asha -- completes, so the spend can overshoot by that unit).
         """
         self.data = data
         self.trial_fn = trial_fn
         self.metric = metric
+        self.stop = dict(stop) if stop else None
         self.mode = automl_metrics.mode_of(metric)
         num_samples = 1
         if recipe is not None:
@@ -141,11 +170,11 @@ class SearchEngine:
     def run(self) -> TrialOutput:
         if self.trial_fn is None:
             raise RuntimeError("compile() first")
-        if self.executor == "process" and len(self.configs) > 1:
-            self.trials = self._run_pool()
+        self.total_trial_epochs = 0
+        if self.scheduler == "asha" and len(self.configs) > 1:
+            self.trials = self._run_asha()
         else:
-            self.trials = [_trial_entry(self.trial_fn, c, self.data)
-                           for c in self.configs]
+            self.trials = self._run_fifo()
         self._log_trials()
         ok = [t for t in self.trials if t.error is None]
         if not ok:
@@ -155,7 +184,116 @@ class SearchEngine:
                                f"{errors}")
         return self.get_best_trials(1)[0]
 
-    def _run_pool(self) -> List[TrialOutput]:
+    def _run_fifo(self) -> List[TrialOutput]:
+        """Every config at its full budget; stop criteria between
+        trials (sequential) or between submission waves (pool)."""
+        if not self.stop:
+            self.total_trial_epochs = sum(
+                int(c.get("epochs", 1)) for c in self.configs)
+            return self._run_trials(self.configs)
+        outs: List[TrialOutput] = []
+        wave = (self.max_workers if self.executor == "process" else 1)
+        i = 0
+        while i < len(self.configs):
+            if self._epoch_cap_reached():
+                logger.info("fifo: total_epochs cap reached after %d "
+                            "trials", i)
+                break
+            chunk = self.configs[i:i + wave]
+            outs.extend(self._run_trials(chunk))
+            self.total_trial_epochs += sum(
+                int(c.get("epochs", 1)) for c in chunk)
+            i += len(chunk)
+            if self._reward_reached(
+                    [t.reward for t in outs if t.error is None]):
+                logger.info("fifo: reward target reached after %d "
+                            "trials", i)
+                break
+        return outs
+
+    def _run_asha(self) -> List[TrialOutput]:
+        """Synchronous successive halving over cumulative epoch rungs.
+
+        Configs re-train from scratch at each rung's (larger) budget --
+        trials here are short CPU fits, so re-running beats carrying
+        checkpoint state across a process pool; the asymptotic budget
+        shape matches ASHA's (geometric rungs, top-1/rf promotion).
+        Configs whose own epoch budget a rung already covered are NOT
+        re-run; their previous result carries forward.
+        """
+        import math
+
+        rf = self.reduction_factor
+        n = len(self.configs)
+        max_ep = max(int(c.get("epochs", 1)) for c in self.configs)
+        budgets: List[int] = []
+        b = self.grace_epochs
+        while b < max_ep:
+            budgets.append(b)
+            b *= rf
+        budgets.append(max_ep)
+        # latest result per ORIGINAL config index (eliminated configs
+        # keep their last-rung result so nothing drops out of trials/
+        # logging/get_best_trials)
+        results: List[Optional[TrialOutput]] = [None] * n
+        ran_epochs = [0] * n  # effective epochs of the stored result
+        alive = list(range(n))
+        for rung, budget in enumerate(budgets):
+            final = rung == len(budgets) - 1
+            todo = []
+            for i in alive:
+                eff = min(budget, int(self.configs[i].get("epochs", 1)))
+                if eff != ran_epochs[i]:  # budget already covered: skip
+                    todo.append((i, eff))
+            rung_cfgs = [dict(self.configs[i], epochs=eff)
+                         for i, eff in todo]
+            outs = self._run_trials(rung_cfgs)
+            self.total_trial_epochs += sum(eff for _, eff in todo)
+            for (i, eff), t in zip(todo, outs):
+                t.extras["rung"] = rung
+                t.extras["rung_epochs"] = eff
+                t.config = self.configs[i]  # report the full budget
+                results[i] = t
+                ran_epochs[i] = eff
+            scored = sorted(
+                [(results[i].reward, i) for i in alive
+                 if results[i] is not None
+                 and results[i].error is None],
+                key=lambda p: p[0], reverse=self.mode == "max")
+            if not scored:
+                return [r for r in results if r is not None]
+            logger.info("asha rung %d (%d epochs): %d/%d trials, "
+                        "best %s=%.6g", rung, budget, len(scored),
+                        len(alive), self.metric, scored[0][0])
+            if final or self._reward_reached([scored[0][0]])                     or self._epoch_cap_reached():
+                if not final:
+                    logger.info("asha: stop criteria met at rung %d",
+                                rung)
+                break
+            keep = max(1, math.ceil(len(scored) / rf))
+            alive = [i for _, i in scored[:keep]]
+        return [r for r in results if r is not None]
+
+    def _epoch_cap_reached(self) -> bool:
+        cap = (self.stop or {}).get("total_epochs")
+        return cap is not None and self.total_trial_epochs >= cap
+
+    def _reward_reached(self, rewards: List[float]) -> bool:
+        target = (self.stop or {}).get("reward")
+        if target is None or not rewards:
+            return False
+        best = max(rewards) if self.mode == "max" else min(rewards)
+        return best >= target if self.mode == "max" else best <= target
+
+    def _run_trials(self, configs: List[Dict[str, Any]]
+                    ) -> List[TrialOutput]:
+        if self.executor == "process" and len(configs) > 1:
+            return self._run_pool(configs)
+        return [_trial_entry(self.trial_fn, c, self.data)
+                for c in configs]
+
+    def _run_pool(self, configs: List[Dict[str, Any]]
+                  ) -> List[TrialOutput]:
         import multiprocessing as mp
         from concurrent.futures import ProcessPoolExecutor
 
@@ -168,7 +306,7 @@ class SearchEngine:
             # submit carries only the config + the sentinel
             futures = [pool.submit(_trial_entry, self.trial_fn, c,
                                    _FROM_WORKER)
-                       for c in self.configs]
+                       for c in configs]
             return [f.result() for f in futures]
 
     def _log_trials(self) -> None:

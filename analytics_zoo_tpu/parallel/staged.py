@@ -132,57 +132,45 @@ class PipelinedTransformerLM:
         use_pipe = (pipe > 1 and self.n_block % pipe == 0
                     and b % m == 0 and (b // m) % data == 0)
         dropout = self.dropout_on and training and rng is not None
+        n_block = self.n_block
         if use_pipe:
             bps = self.n_block // pipe
             stage_params = jax.tree_util.tree_map(
                 lambda a: a.reshape((pipe, bps) + a.shape[1:]), blocks)
             mb = h.reshape((m, b // m) + h.shape[1:])
+            data_axis = "data" if data > 1 else None
 
-            if dropout:
-                n_block = self.n_block
-                data_axis = "data" if data > 1 else None
-
-                def stage_fn(sp, a, mb_idx, stage_id, key):
+            def stage_fn(sp, a, *ctx):
+                # ctx = (mb_idx, stage_id, key) when pipeline_apply got
+                # an rng; empty otherwise (see pipeline_apply contract)
+                key = None
+                if ctx:
+                    mb_idx, stage_id, key = ctx
                     if data_axis is not None:
                         # per-data-shard masks: a replicated key would
                         # repeat one mask across dp shards
                         key = jax.random.fold_in(
                             key, lax.axis_index(data_axis))
 
-                    def body(carry, layer_j):
-                        layer, j = layer_j
-                        k = jax.random.fold_in(
-                            key, mb_idx * n_block + stage_id * bps + j)
-                        out = self._block.apply(
-                            {"params": layer}, carry, train=True,
-                            rngs={"dropout": k})
-                        return out, None
+                def body(carry, layer_j):
+                    layer, j = layer_j
+                    k = (None if key is None else jax.random.fold_in(
+                        key, mb_idx * n_block + stage_id * bps + j))
+                    return self._apply_block(layer, carry, k), None
 
-                    out, _ = lax.scan(body, a, (sp, jnp.arange(bps)))
-                    return out
+                out, _ = lax.scan(body, a, (sp, jnp.arange(bps)))
+                return out
 
-                out = pipeline_apply(
-                    stage_fn, stage_params, mb, mesh, axis_name="pipe",
-                    data_axis="data" if data > 1 else None, rng=rng)
-            else:
-                def stage_fn(sp, a):
-                    def body(carry, layer):
-                        return self._block.apply({"params": layer},
-                                                 carry), None
-
-                    out, _ = lax.scan(body, a, sp)
-                    return out
-
-                out = pipeline_apply(
-                    stage_fn, stage_params, mb, mesh, axis_name="pipe",
-                    data_axis="data" if data > 1 else None)
+            out = pipeline_apply(
+                stage_fn, stage_params, mb, mesh, axis_name="pipe",
+                data_axis=data_axis, rng=rng if dropout else None)
             h = out.reshape((b,) + h.shape[1:])
         elif dropout:
             # sequential fallback with the SAME per-(microbatch, block)
-            # key formula, so dp and pp draw identical masks. A batch
-            # the microbatch count doesn't divide degrades to one
-            # microbatch (the pipeline wouldn't engage there either).
-            n_block = self.n_block
+            # key formula, so pipe-only pp and sequential draw identical
+            # masks. A batch the microbatch count doesn't divide
+            # degrades to one microbatch (the pipeline wouldn't engage
+            # there either).
             if b % m != 0:
                 m = 1
             hm = h.reshape((m, b // m) + h.shape[1:])
@@ -192,20 +180,27 @@ class PipelinedTransformerLM:
 
                 def per_mb(mb_h, mb_idx):
                     k = jax.random.fold_in(rng, mb_idx * n_block + j)
-                    return self._block.apply(
-                        {"params": layer}, mb_h, train=True,
-                        rngs={"dropout": k})
+                    return self._apply_block(layer, mb_h, k)
 
                 return jax.vmap(per_mb)(carry, jnp.arange(m)), None
 
-            hm, _ = lax.scan(body, hm, (blocks, jnp.arange(self.n_block)))
+            hm, _ = lax.scan(body, hm, (blocks, jnp.arange(n_block)))
             h = hm.reshape((b,) + h.shape[1:])
         else:
             def body(carry, layer):
-                return self._block.apply({"params": layer}, carry), None
+                return self._apply_block(layer, carry), None
 
             h, _ = lax.scan(body, h, blocks)
         return h, {}
+
+    def _apply_block(self, layer_params, h, dropout_key=None):
+        """One TransformerBlock application, optionally with a dropout
+        key -- the single site every path above funnels through."""
+        if dropout_key is None:
+            return self._block.apply({"params": layer_params}, h)
+        return self._block.apply({"params": layer_params}, h,
+                                 train=True,
+                                 rngs={"dropout": dropout_key})
 
     def __call__(self, variables, x):
         return self.apply(variables, x)[0]

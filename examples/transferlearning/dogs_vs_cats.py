@@ -96,6 +96,15 @@ def main():
     print(f"cold-started: {cold_res}")
     print(f"transfer advantage (loss): "
           f"{cold_res['loss'] - warm_res['loss']:+.4f}")
+    # the notebook's end-to-end quality story, as a hard bar: the
+    # warm-started model must actually be good AND beat cold-start
+    bar = 0.9
+    assert warm_res["accuracy"] >= bar, (
+        f"quality bar missed: warm accuracy "
+        f"{warm_res['accuracy']:.3f} < {bar}")
+    assert warm_res["loss"] < cold_res["loss"], (warm_res, cold_res)
+    print(f"quality bar met: warm accuracy "
+          f"{warm_res['accuracy']:.3f} >= {bar} and beats cold start")
 
 
 if __name__ == "__main__":
