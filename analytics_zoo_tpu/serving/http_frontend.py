@@ -245,7 +245,8 @@ class HttpFrontend:
             if not isinstance(inputs, dict) or not inputs:
                 return 400, {"error": "inputs must be a non-empty object"}
             try:
-                tensors = {k: np.asarray(v) for k, v in inputs.items()}
+                tensors = {k: self._as_tensor(v)
+                           for k, v in inputs.items()}
             except (ValueError, TypeError) as e:
                 return 400, {"error": f"bad tensor: {e}"}
             for k, a in tensors.items():
@@ -260,6 +261,20 @@ class HttpFrontend:
                 # surfaces Redis OOM as an error, FrontEndApp/client.py)
                 return 503, {"error": "input queue full"}
         return 200, None
+
+    @staticmethod
+    def _as_tensor(value) -> np.ndarray:
+        """JSON value -> tensor. ``{"b64": "..."}`` carries base64 bytes
+        (TF-serving convention; the reference's frontend ships base64
+        images the same way, FrontEndApp.scala + PreProcessing
+        decodeImage) -- delivered as a uint8 byte tensor the worker's
+        image sniffer decodes."""
+        if isinstance(value, dict) and set(value) == {"b64"}:
+            import base64
+
+            raw = base64.b64decode(value["b64"], validate=True)
+            return np.frombuffer(raw, np.uint8)
+        return np.asarray(value)
 
     def _await(self, uri: str, deadline: float):
         result = self.router.wait(

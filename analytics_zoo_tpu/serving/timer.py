@@ -15,14 +15,18 @@ from typing import Dict, List
 
 
 class _StageStat:
-    __slots__ = ("count", "total", "max", "min", "top")
+    __slots__ = ("count", "total", "max", "min", "top", "samples",
+                 "_cap")
 
-    def __init__(self):
+    def __init__(self, keep_samples: int = 0):
         self.count = 0
         self.total = 0.0
         self.max = 0.0
         self.min = float("inf")
         self.top: List[float] = []  # min-heap of the 10 largest
+        # raw sample ring (percentiles); 0 disables
+        self.samples: List[float] = [] if keep_samples else None
+        self._cap = keep_samples
 
     def record(self, dt: float) -> None:
         self.count += 1
@@ -33,11 +37,23 @@ class _StageStat:
             heapq.heappush(self.top, dt)
         else:
             heapq.heappushpop(self.top, dt)
+        if self.samples is not None:
+            if len(self.samples) >= self._cap:
+                self.samples[self.count % self._cap] = dt
+            else:
+                self.samples.append(dt)
 
 
 class Timer:
-    def __init__(self):
+    """``keep_samples``: per-stage raw-sample ring size; when > 0 the
+    summary gains p50_s/p99_s percentiles (the reference prints only
+    total/avg/max/min/top-10, Timer.scala:24-90; percentiles are what
+    the serving bench needs to split worker service time from client
+    latency)."""
+
+    def __init__(self, keep_samples: int = 0):
         self._stats: Dict[str, _StageStat] = {}
+        self._keep = keep_samples
         self._lock = threading.Lock()
 
     @contextmanager
@@ -49,7 +65,16 @@ class Timer:
         finally:
             dt = time.perf_counter() - t0
             with self._lock:
-                self._stats.setdefault(name, _StageStat()).record(dt)
+                self._stats.setdefault(
+                    name, _StageStat(self._keep)).record(dt)
+
+    def record(self, name: str, dt: float) -> None:
+        """Record an externally-measured duration (spans that cross
+        function boundaries, e.g. the worker's pipelined batch
+        service time)."""
+        with self._lock:
+            self._stats.setdefault(
+                name, _StageStat(self._keep)).record(dt)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -66,6 +91,11 @@ class Timer:
                     "top10_avg_s": (sum(s.top) / len(s.top)
                                     if s.top else 0.0),
                 }
+                if s.samples:
+                    ordered = sorted(s.samples)
+                    out[name]["p50_s"] = ordered[len(ordered) // 2]
+                    out[name]["p99_s"] = ordered[
+                        min(len(ordered) - 1, int(len(ordered) * 0.99))]
             return out
 
     def reset(self) -> None:

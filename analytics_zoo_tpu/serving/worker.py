@@ -14,7 +14,9 @@ serving/engine/Timer.scala:24-90).
 from __future__ import annotations
 
 import collections
+import os
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -28,6 +30,76 @@ from analytics_zoo_tpu.serving.timer import Timer
 logger = get_logger(__name__)
 
 ERROR_KEY = "__error__"
+
+# compressed-image magic numbers: requests may ship JPEG/PNG bytes
+# instead of raw pixel tensors (the reference decodes base64 images
+# server-side, ref: zoo/.../serving/preprocessing/PreProcessing.scala:
+# 83-99 decodeImage); a 224x224x3 JPEG is ~10-20x smaller on the wire
+_JPEG_MAGIC = b"\xff\xd8\xff"
+_PNG_MAGIC = b"\x89PNG\r\n\x1a\n"
+
+
+def _is_image_bytes(a: np.ndarray) -> bool:
+    if a.ndim != 1 or a.dtype != np.uint8 or a.size < 8:
+        return False
+    head = a[:8].tobytes()
+    return head.startswith(_JPEG_MAGIC) or head == _PNG_MAGIC
+
+
+def _decode_one_image(a: np.ndarray) -> np.ndarray:
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(a.tobytes()))
+    return np.asarray(img.convert("RGB"), np.uint8)
+
+
+_decode_pool = None
+_decode_pool_lock = threading.Lock()
+
+
+def _image_pool():
+    """Shared decode pool: PIL releases the GIL during JPEG decode, so
+    a thread pool decodes a 32-image batch ~cores-x faster than the
+    serial loop (which would otherwise dominate worker service time)."""
+    global _decode_pool
+    with _decode_pool_lock:
+        if _decode_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _decode_pool = ThreadPoolExecutor(
+                max_workers=min(16, os.cpu_count() or 4))
+        return _decode_pool
+
+
+def decode_image_tensors(tensors: Dict[str, np.ndarray]
+                         ) -> Dict[str, np.ndarray]:
+    """Replace any 1-D uint8 tensor holding JPEG/PNG bytes with the
+    decoded [H, W, 3] uint8 pixel array (host-side PIL decode, the
+    PreProcessing.decodeImage role). Non-image tensors pass through."""
+    return {k: (_decode_one_image(np.asarray(v))
+                if _is_image_bytes(np.asarray(v)) else np.asarray(v))
+            for k, v in tensors.items()}
+
+
+def decode_image_batch(items):
+    """Decode every image tensor across a whole micro-batch through the
+    shared thread pool (batch-level parallelism beats per-request)."""
+    jobs = []
+    for idx, (uri, tensors, reply) in enumerate(items):
+        for k, v in tensors.items():
+            a = np.asarray(v)
+            if _is_image_bytes(a):
+                jobs.append((idx, k, a))
+    if not jobs:
+        return items
+    pool = _image_pool()
+    decoded = list(pool.map(lambda j: _decode_one_image(j[2]), jobs))
+    out = [(u, dict(t), r) for u, t, r in items]
+    for (idx, k, _), img in zip(jobs, decoded):
+        out[idx][1][k] = img
+    return out
 
 
 def _default_input_fn(tensors: Dict[str, np.ndarray]) -> Any:
@@ -83,7 +155,7 @@ class ServingWorker:
         self.input_fn = input_fn
         self.output_fn = output_fn
         self.top_n = top_n
-        self.timer = timer or Timer()
+        self.timer = timer or Timer(keep_samples=4096)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.served = 0
@@ -105,6 +177,7 @@ class ServingWorker:
         """One pull→predict→push cycle; returns requests served."""
         with self.timer.timing("batch_wait"):
             blobs = self.batcher.next_batch(wait_timeout=wait_timeout)
+        self._batch_t0 = time.perf_counter()
         if not blobs:
             n = 0
             while self._inflight:  # idle: drain pipelined batches
@@ -116,11 +189,11 @@ class ServingWorker:
                               Optional[str]]] = []
             for b in blobs:
                 try:
-                    uri, tensors, reply = _decode_full(b)
-                    items.append((uri, tensors, reply))
+                    items.append(_decode_full(b))
                 except Exception as e:  # malformed blob: drop, keep serving
                     logger.exception("serving: undecodable request "
                                      "dropped: %s", e)
+            items = decode_image_batch(items)
         groups = self._group_compatible(items)
         n = 0
         for group in groups:
@@ -171,7 +244,8 @@ class ServingWorker:
             for uri, reply in zip(uris, replies):
                 self._push_error(uri, reply, str(e))
             return len(group)
-        self._inflight.append((uris, replies, preds, n))
+        self._inflight.append((uris, replies, preds, n,
+                               self._batch_t0))
         return 0  # counted when finalized
 
     def _finalize_one(self) -> int:
@@ -179,9 +253,14 @@ class ServingWorker:
         (async dispatch errors surface here). Never raises: push-path
         failures (broker down, spool disk full) must not kill the
         serving loop -- callers sit outside the batch guard."""
-        uris, replies, preds, n = self._inflight.popleft()
+        uris, replies, preds, n, t0 = self._inflight.popleft()
         try:
-            return self._finalize_inner(uris, replies, preds, n)
+            served = self._finalize_inner(uris, replies, preds, n)
+            # worker-side service time for this batch: decode start ->
+            # results pushed (excludes queue wait; the honest split the
+            # bench reports next to client-observed latency)
+            self.timer.record("service", time.perf_counter() - t0)
+            return served
         except Exception as e:
             logger.exception("serving finalize failed (results for %d "
                              "requests lost): %s", len(uris), e)

@@ -294,3 +294,91 @@ class TestLauncher:
 
         with pytest.raises(ValueError, match="model.path"):
             launch({"model": {}})
+
+
+class TestCompressedImageIngestion:
+    """Server-side JPEG/PNG decode (VERDICT round-3 item 4; ref:
+    PreProcessing.scala:83-99 decodeImage)."""
+
+    @staticmethod
+    def _jpeg_bytes(h=32, w=32, seed=0):
+        import io
+
+        from PIL import Image
+
+        rng = np.random.RandomState(seed)
+        img = Image.fromarray(rng.randint(0, 255, (h, w, 3), np.uint8))
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG", quality=90)
+        return buf.getvalue()
+
+    def test_decode_image_tensors_jpeg_and_png(self):
+        import io
+
+        from PIL import Image
+
+        from analytics_zoo_tpu.serving.worker import decode_image_tensors
+
+        raw = self._jpeg_bytes()
+        t = decode_image_tensors(
+            {"image": np.frombuffer(raw, np.uint8),
+             "meta": np.asarray([1.0, 2.0], np.float32)})
+        assert t["image"].shape == (32, 32, 3)
+        assert t["image"].dtype == np.uint8
+        assert t["meta"].tolist() == [1.0, 2.0]
+        # PNG round-trips losslessly
+        arr = np.random.RandomState(1).randint(0, 255, (8, 8, 3),
+                                               np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        t2 = decode_image_tensors(
+            {"x": np.frombuffer(buf.getvalue(), np.uint8)})
+        np.testing.assert_array_equal(t2["x"], arr)
+
+    def test_plain_uint8_vectors_pass_through(self):
+        from analytics_zoo_tpu.serving.worker import decode_image_tensors
+
+        v = np.arange(16, dtype=np.uint8)
+        out = decode_image_tensors({"v": v})
+        np.testing.assert_array_equal(out["v"], v)
+
+    def test_enqueue_image_roundtrip_through_worker(self):
+        from analytics_zoo_tpu.serving.queues import (
+            InputQueue, OutputQueue)
+        from analytics_zoo_tpu.serving.worker import ServingWorker
+
+        class MeanModel:
+            def predict(self, x):
+                # x: [N, H, W, 3] uint8 stacked by the worker
+                assert x.dtype == np.uint8 and x.ndim == 4
+                return x.astype(np.float32).mean(axis=(1, 2, 3))
+
+        in_q, out_q = InputQueue(), OutputQueue()
+        worker = ServingWorker(MeanModel(), in_q, out_q, batch_size=4)
+        raw = self._jpeg_bytes(seed=3)
+        assert in_q.enqueue_image("req-1", raw)
+        worker.process_one_batch(wait_timeout=0.5)
+        worker.process_one_batch(wait_timeout=0.1)  # drain pipeline
+        uri, result = out_q.dequeue(timeout=2.0)
+        assert uri == "req-1"
+        from PIL import Image
+        import io as _io
+
+        want = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"),
+                          np.float32).mean()
+        np.testing.assert_allclose(float(result["output"]), want,
+                                   rtol=1e-5)
+
+    def test_http_b64_image(self):
+        import base64
+
+        from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
+
+        raw = self._jpeg_bytes(seed=4)
+        fe = HttpFrontend.__new__(HttpFrontend)  # only _as_tensor
+        t = fe._as_tensor({"b64": base64.b64encode(raw).decode()})
+        assert t.dtype == np.uint8
+        np.testing.assert_array_equal(t, np.frombuffer(raw, np.uint8))
+        # non-b64 dicts and plain lists behave as before
+        np.testing.assert_array_equal(fe._as_tensor([1, 2]),
+                                      np.asarray([1, 2]))
