@@ -77,15 +77,23 @@ def decode_image_tensors(tensors: Dict[str, np.ndarray]
                          ) -> Dict[str, np.ndarray]:
     """Replace any 1-D uint8 tensor holding JPEG/PNG bytes with the
     decoded [H, W, 3] uint8 pixel array (host-side PIL decode, the
-    PreProcessing.decodeImage role). Non-image tensors pass through."""
-    return {k: (_decode_one_image(np.asarray(v))
-                if _is_image_bytes(np.asarray(v)) else np.asarray(v))
-            for k, v in tensors.items()}
+    PreProcessing.decodeImage role). Non-image tensors pass through;
+    undecodable image bytes raise (the batch path maps that to a
+    per-request error)."""
+    ok, failures = decode_image_batch([("", tensors, None)])
+    if failures:
+        raise ValueError(f"undecodable image bytes: {failures[0][2]}")
+    return ok[0][1]
 
 
 def decode_image_batch(items):
     """Decode every image tensor across a whole micro-batch through the
-    shared thread pool (batch-level parallelism beats per-request)."""
+    shared thread pool (batch-level parallelism beats per-request).
+
+    Returns ``(decoded_items, failures)`` where failures are
+    ``(uri, reply, message)`` for requests whose image bytes would not
+    decode -- one corrupt upload must error that request, never the
+    worker (same invariant as the per-blob decode guard)."""
     jobs = []
     for idx, (uri, tensors, reply) in enumerate(items):
         for k, v in tensors.items():
@@ -93,13 +101,29 @@ def decode_image_batch(items):
             if _is_image_bytes(a):
                 jobs.append((idx, k, a))
     if not jobs:
-        return items
+        return items, []
+
+    def safe_decode(job):
+        try:
+            return _decode_one_image(job[2])
+        except Exception as e:
+            return e
+
     pool = _image_pool()
-    decoded = list(pool.map(lambda j: _decode_one_image(j[2]), jobs))
+    decoded = list(pool.map(safe_decode, jobs))
     out = [(u, dict(t), r) for u, t, r in items]
+    bad = {}
     for (idx, k, _), img in zip(jobs, decoded):
-        out[idx][1][k] = img
-    return out
+        if isinstance(img, Exception):
+            uri, _, reply = items[idx]
+            bad[idx] = (uri, reply, f"image decode failed for "
+                                    f"{k!r}: {img}")
+        else:
+            out[idx][1][k] = img
+    if not bad:
+        return out, []
+    return ([t for i, t in enumerate(out) if i not in bad],
+            list(bad.values()))
 
 
 def _default_input_fn(tensors: Dict[str, np.ndarray]) -> Any:
@@ -193,9 +217,14 @@ class ServingWorker:
                 except Exception as e:  # malformed blob: drop, keep serving
                     logger.exception("serving: undecodable request "
                                      "dropped: %s", e)
-            items = decode_image_batch(items)
+            items, bad_images = decode_image_batch(items)
+        n_failed = 0
+        for uri, reply, msg in bad_images:
+            logger.warning("serving: %s", msg)
+            self._push_error(uri, reply, msg)
+            n_failed += 1
         groups = self._group_compatible(items)
-        n = 0
+        n = n_failed
         for group in groups:
             try:
                 n += self._predict_group(group)
@@ -244,8 +273,11 @@ class ServingWorker:
             for uri, reply in zip(uris, replies):
                 self._push_error(uri, reply, str(e))
             return len(group)
-        self._inflight.append((uris, replies, preds, n,
-                               self._batch_t0))
+        # prep time for THIS batch: decode start -> dispatch issued
+        # (stored so the service metric can exclude the pipeline
+        # residency spent while other batches finalize)
+        prep_s = time.perf_counter() - self._batch_t0
+        self._inflight.append((uris, replies, preds, n, prep_s))
         return 0  # counted when finalized
 
     def _finalize_one(self) -> int:
@@ -253,13 +285,18 @@ class ServingWorker:
         (async dispatch errors surface here). Never raises: push-path
         failures (broker down, spool disk full) must not kill the
         serving loop -- callers sit outside the batch guard."""
-        uris, replies, preds, n, t0 = self._inflight.popleft()
+        uris, replies, preds, n, prep_s = self._inflight.popleft()
+        t0 = time.perf_counter()
         try:
             served = self._finalize_inner(uris, replies, preds, n)
-            # worker-side service time for this batch: decode start ->
-            # results pushed (excludes queue wait; the honest split the
-            # bench reports next to client-observed latency)
-            self.timer.record("service", time.perf_counter() - t0)
+            # worker-side service time for this batch: its own decode/
+            # stack/dispatch prep + its own result fetch + push. The
+            # time the batch sat in the in-flight deque while OTHER
+            # batches finalized is pipeline residency, not service --
+            # excluding it keeps the bench's worker-vs-client latency
+            # split honest at pipeline_depth > 1
+            self.timer.record("service",
+                              prep_s + time.perf_counter() - t0)
             return served
         except Exception as e:
             logger.exception("serving finalize failed (results for %d "

@@ -227,7 +227,7 @@ class TestTableOps:
     def test_mm_rejects_bad_rank(self):
         from analytics_zoo_tpu.keras.layers import MM
 
-        with pytest.raises(ValueError, match="2D or 3D"):
+        with pytest.raises(ValueError, match="both be 2D"):
             MM().build().apply({}, [np.ones((2, 2, 2, 2), np.float32),
                                     np.ones((2, 2), np.float32)])
 

@@ -382,3 +382,31 @@ class TestCompressedImageIngestion:
         # non-b64 dicts and plain lists behave as before
         np.testing.assert_array_equal(fe._as_tensor([1, 2]),
                                       np.asarray([1, 2]))
+
+    def test_corrupt_image_errors_request_not_worker(self):
+        from analytics_zoo_tpu.serving.queues import (
+            InputQueue, OutputQueue)
+        from analytics_zoo_tpu.serving.worker import (
+            ERROR_KEY, ServingWorker)
+
+        class MeanModel:
+            def predict(self, x):
+                return x.astype(np.float32).mean(axis=(1, 2, 3))
+
+        in_q, out_q = InputQueue(), OutputQueue()
+        worker = ServingWorker(MeanModel(), in_q, out_q, batch_size=4)
+        # JPEG magic followed by garbage: sniffer matches, decode fails
+        corrupt = np.frombuffer(b"\xff\xd8\xff" + b"junk" * 8, np.uint8)
+        good = self._jpeg_bytes(seed=9)
+        assert in_q.enqueue("bad-1", image=corrupt)
+        assert in_q.enqueue_image("good-1", good)
+        worker.process_one_batch(wait_timeout=0.5)
+        worker.process_one_batch(wait_timeout=0.1)
+        results = {}
+        for _ in range(2):
+            item = out_q.dequeue(timeout=2.0)
+            assert item is not None
+            results[item[0]] = item[1]
+        assert ERROR_KEY in results["bad-1"]
+        assert "decode failed" in str(results["bad-1"][ERROR_KEY])
+        assert ERROR_KEY not in results["good-1"]  # worker kept serving
