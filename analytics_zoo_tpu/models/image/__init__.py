@@ -7,9 +7,16 @@ from analytics_zoo_tpu.models.image.resnet import (  # noqa: F401
     ResNet50,
 )
 from analytics_zoo_tpu.models.image.backbones import (  # noqa: F401
+    AlexNet,
+    DenseNet,
     InceptionV1,
+    InceptionV3,
     MobileNetV1,
+    MobileNetV2,
+    SqueezeNet,
     VGG16,
+    VGG19,
+    densenet161,
 )
 from analytics_zoo_tpu.models.image.classifier import (  # noqa: F401
     ImageClassifier,

@@ -16,12 +16,20 @@ import numpy as np
 
 from analytics_zoo_tpu.models.common import ZooModel, register_model
 from analytics_zoo_tpu.models.image.backbones import (
-    InceptionV1, MobileNetV1, VGG16)
+    AlexNet, DenseNet, InceptionV1, InceptionV3, MobileNetV1,
+    MobileNetV2, SqueezeNet, VGG16, VGG19, densenet161)
 from analytics_zoo_tpu.models.image.resnet import ResNet18, ResNet50
 
+# the reference's full pretrained family (ref: docs/docs/
+# ProgrammingGuide/image-classification.md:60-80 -- alexnet,
+# inception-v1/v3, vgg-16/19, resnet-50, densenet-161, mobilenet,
+# mobilenet-v2, squeezenet), every member trainable here
 _BACKBONES = {"resnet18": ResNet18, "resnet50": ResNet50,
-              "inception-v1": InceptionV1, "mobilenet": MobileNetV1,
-              "vgg16": VGG16}
+              "inception-v1": InceptionV1, "inception-v3": InceptionV3,
+              "mobilenet": MobileNetV1, "mobilenet-v2": MobileNetV2,
+              "vgg16": VGG16, "vgg19": VGG19, "alexnet": AlexNet,
+              "squeezenet": SqueezeNet, "densenet121": DenseNet,
+              "densenet161": densenet161}
 
 # ImageNet channel stats (the reference's ImageChannelNormalize defaults)
 _MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)

@@ -290,11 +290,13 @@ class ServingWorker:
         try:
             served = self._finalize_inner(uris, replies, preds, n)
             # worker-side service time for this batch: its own decode/
-            # stack/dispatch prep + its own result fetch + push. The
-            # time the batch sat in the in-flight deque while OTHER
-            # batches finalized is pipeline residency, not service --
-            # excluding it keeps the bench's worker-vs-client latency
-            # split honest at pipeline_depth > 1
+            # stack/dispatch prep + its remaining result wait + push.
+            # Residency in the in-flight deque while OTHER batches
+            # finalize is excluded -- which also means device compute
+            # that OVERLAPPED that residency doesn't show up here; this
+            # is "host work + un-overlapped device wait", the marginal
+            # per-batch cost under pipelining (zero overlap = full
+            # decode->predict->push)
             self.timer.record("service",
                               prep_s + time.perf_counter() - t0)
             return served

@@ -322,3 +322,62 @@ class TestBackboneBreadth:
                                 image_size=64)
         hist = model.fit((x, y), batch_size=16, epochs=2)
         assert np.isfinite(hist[-1]["loss"])
+
+
+class TestFullBackboneFamily:
+    """Every member of the reference's pretrained family is a
+    trainable backbone (ref: docs ProgrammingGuide/image-classification
+    .md:60-80: alexnet, inception-v1/v3, vgg-16/19, resnet-50,
+    densenet-161, mobilenet(-v2), squeezenet)."""
+
+    def test_family_complete(self):
+        from analytics_zoo_tpu.models.image.classifier import _BACKBONES
+
+        for name in ("alexnet", "inception-v1", "inception-v3",
+                     "vgg16", "vgg19", "resnet50", "densenet121",
+                     "densenet161", "mobilenet", "mobilenet-v2",
+                     "squeezenet"):
+            assert name in _BACKBONES, name
+        assert len(_BACKBONES) >= 11
+
+    @pytest.mark.parametrize("backbone,size", [
+        ("squeezenet", 64), ("mobilenet-v2", 64),
+        ("densenet121", 64)])
+    def test_forward_shapes(self, backbone, size):
+        model = ImageClassifier(class_num=3, backbone=backbone,
+                                image_size=size)
+        x = np.random.RandomState(0).rand(8, size, size, 3) \
+            .astype(np.float32)
+        preds = model.predict(x, batch_size=8)
+        assert preds.shape == (8, 3)
+        assert np.isfinite(preds).all()
+
+    def test_param_counts_match_published_architectures(self):
+        """Structural goldens: parameter totals at 1000 classes must
+        land near the published sizes (squeezenet ~1.2M, mobilenet-v2
+        ~3.5M, densenet-121 ~8.0M, inception-v3 ~25M sans aux head)."""
+        import jax
+
+        from analytics_zoo_tpu.models.image.backbones import (
+            DenseNet, InceptionV3, MobileNetV2, SqueezeNet)
+
+        def count(m, size):
+            v = m.init({"params": jax.random.PRNGKey(0),
+                        "dropout": jax.random.PRNGKey(1)},
+                       np.zeros((1, size, size, 3), np.float32))
+            return sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(v["params"]))
+
+        assert 1.0e6 < count(SqueezeNet(), 64) < 1.6e6
+        assert 3.0e6 < count(MobileNetV2(), 64) < 4.2e6
+        assert 7.2e6 < count(DenseNet(), 64) < 8.8e6
+        assert 21e6 < count(InceptionV3(), 128) < 27e6
+
+    def test_densenet_trains(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(16, 64, 64, 3).astype(np.float32)
+        y = (x[:, :8, :8, 1].mean(axis=(1, 2)) > 0.5).astype(np.int32)
+        model = ImageClassifier(class_num=2, backbone="densenet121",
+                                image_size=64)
+        hist = model.fit((x, y), batch_size=8, epochs=2)
+        assert np.isfinite(hist[-1]["loss"])
