@@ -19,6 +19,11 @@ from analytics_zoo_tpu.inference.encrypt import (  # noqa: F401
     decrypt_bytes,
     encrypt_bytes,
 )
+from analytics_zoo_tpu.inference.graph_executor import (  # noqa: F401
+    GraphFunction,
+    load_onnx_model,
+    load_tf_frozen_graph,
+)
 from analytics_zoo_tpu.inference.graph_model import (  # noqa: F401
     GraphModel,
 )
