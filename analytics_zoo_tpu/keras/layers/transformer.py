@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from typing import Any, Optional, Tuple
 
+from functools import partial
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -20,6 +22,22 @@ import numpy as np
 
 from analytics_zoo_tpu.keras.layers.base import KerasLayer
 from analytics_zoo_tpu.ops.attention import dot_product_attention
+
+_zigzag_shape_warned = False
+
+
+def _warn_zigzag_shape_once(l, seq_size):
+    global _zigzag_shape_warned
+    if not _zigzag_shape_warned:
+        _zigzag_shape_warned = True
+        from analytics_zoo_tpu.common.log import get_logger
+
+        get_logger(__name__).warning(
+            "ring_schedule=zigzag requested but seq_len %d is not "
+            "divisible by 2*seq_axis_size (%d); falling back to the "
+            "contiguous causal ring (~2x more attention compute)",
+            l, 2 * seq_size)
+
 
 class MultiHeadSelfAttention(nn.Module):
     """``seq_axis``: name of a mesh axis to shard the sequence over --
@@ -71,12 +89,33 @@ class MultiHeadSelfAttention(nn.Module):
                 # ring layout [B, L, H, D]; shard_map nests inside the
                 # outer jit and reshards q/k/v along the seq axis.
                 # Prob-dropout applies tile-wise inside the ring (exact;
-                # see ring_attention's numerator-only masking)
-                out = ring_attention(
+                # see ring_attention's numerator-only masking). Causal
+                # stacks take the zigzag schedule when shapes divide:
+                # same exact softmax, ~2x less compute (ring_schedule
+                # config: auto|zigzag|contiguous)
+                from analytics_zoo_tpu.common.config import get_config
+                from analytics_zoo_tpu.parallel.ring_attention import (
+                    zigzag_ring_attention)
+
+                schedule = get_config().get("zoo.ops.ring_schedule")
+                if schedule not in ("auto", "zigzag", "contiguous"):
+                    raise ValueError(
+                        f"zoo.ops.ring_schedule must be auto|zigzag|"
+                        f"contiguous, got {schedule!r}")
+                divides = l % (2 * seq_size) == 0
+                if schedule == "zigzag" and self.causal and not divides:
+                    _warn_zigzag_shape_once(l, seq_size)
+                use_zigzag = (self.causal
+                              and schedule in ("auto", "zigzag")
+                              and divides)
+                ring_fn = (zigzag_ring_attention if use_zigzag
+                           else partial(ring_attention,
+                                        causal=self.causal))
+                out = ring_fn(
                     q.reshape(b, l, self.n_head, hd),
                     k.reshape(b, l, self.n_head, hd),
                     v.reshape(b, l, self.n_head, hd),
-                    mesh, axis_name=self.seq_axis, causal=self.causal,
+                    mesh, axis_name=self.seq_axis,
                     dropout_rate=self.attn_dropout if train else 0.0,
                     dropout_rng=ring_rng,
                 ).reshape(b, l, self.hidden_size)

@@ -31,6 +31,7 @@ from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
 from analytics_zoo_tpu.parallel import collectives  # noqa: F401
 from analytics_zoo_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
+    zigzag_ring_attention,
     ring_self_attention,
 )
 from analytics_zoo_tpu.parallel.pipeline import (  # noqa: F401

@@ -121,6 +121,30 @@ def _ring_attn_local(q, k, v, rng, axis_name: str, causal: bool,
     return out.astype(q.dtype)
 
 
+def _ring_shard_call(local_fn, q, k, v, mesh, axis_name, qkv_spec,
+                     dropout_rate, dropout_rng, **fn_kwargs):
+    """Shared wrapper for the ring bodies: derives the default spec,
+    detects the batch-sharding axis (per-shard dropout keys), builds
+    the shard_map and threads the optional rng operand."""
+    if qkv_spec is None:
+        data = "data" if "data" in mesh.axis_names else None
+        qkv_spec = P(data, axis_name, None, None)
+    dropping = dropout_rng is not None and dropout_rate > 0.0
+    batch_axis = qkv_spec[0] if len(qkv_spec) > 0 else None
+    if not isinstance(batch_axis, str):
+        batch_axis = None
+    extra = (dropout_rng,) if dropping else ()
+    fn = jax.shard_map(
+        partial(local_fn, axis_name=axis_name,
+                dropout_rate=dropout_rate if dropping else 0.0,
+                batch_axis=batch_axis if dropping else None,
+                **({} if dropping else {"rng": None}), **fn_kwargs),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec) + (P(),) * len(extra),
+        out_specs=qkv_spec, check_vma=False)
+    return fn(q, k, v, *extra)
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
                    causal: bool = False, scale: Optional[float] = None,
                    qkv_spec: Optional[P] = None,
@@ -139,24 +163,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
         so the ring schedule applies exact elementwise prob dropout
         (see ``_block_attn``). Pass a key only when training.
     """
-    if qkv_spec is None:
-        data = "data" if "data" in mesh.axis_names else None
-        qkv_spec = P(data, axis_name, None, None)
-    dropping = dropout_rng is not None and dropout_rate > 0.0
-    batch_axis = qkv_spec[0] if len(qkv_spec) > 0 else None
-    if not isinstance(batch_axis, str):
-        batch_axis = None
-    extra = (dropout_rng,) if dropping else ()
-    fn = jax.shard_map(
-        partial(_ring_attn_local, axis_name=axis_name, causal=causal,
-                scale=scale,
-                dropout_rate=dropout_rate if dropping else 0.0,
-                batch_axis=batch_axis if dropping else None,
-                **({} if dropping else {"rng": None})),
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec) + (P(),) * len(extra),
-        out_specs=qkv_spec, check_vma=False)
-    return fn(q, k, v, *extra)
+    return _ring_shard_call(_ring_attn_local, q, k, v, mesh,
+                            axis_name, qkv_spec, dropout_rate,
+                            dropout_rng, causal=causal, scale=scale)
 
 
 def ring_self_attention(x, wq, wk, wv, wo, num_heads: int, mesh: Mesh,
@@ -177,3 +186,153 @@ def ring_self_attention(x, wq, wk, wv, wo, num_heads: int, mesh: Mesh,
                          axis_name=axis_name, causal=causal)
     out = out.reshape(b, s, dim)
     return jnp.einsum("bsd,de->bse", out, wo)
+
+
+# ===================================================== zigzag ring ====
+# Load-balanced causal schedule. The contiguous ring wastes ~half its
+# FLOPs under causal masking: device 0's queries attend almost nothing
+# (its tile is fully masked on n-1 of n ring steps, computed then
+# discarded) while device n-1 computes every step. The zigzag layout
+# (Llama-3-style "zig-zag" / striped ring attention) splits the
+# sequence into 2n chunks and gives device i the PAIR (i, 2n-1-i) --
+# one early (light) and one late (heavy) chunk -- so every device does
+# the same ~2 chunk-tiles of unmasked work per step, and fully-masked
+# tiles are skipped with a per-core `lax.cond` instead of computed.
+# Net: ~2x less attention compute than the contiguous causal ring at
+# the same exactness (online softmax over the same global tiles).
+
+
+def _zigzag_chunk_perm(seq_len: int, n_dev: int):
+    """Row permutation mapping the natural sequence layout to the
+    zigzag layout (device i holds chunks i and 2n-1-i, concatenated).
+    Returns (perm, inverse_perm)."""
+    if seq_len % (2 * n_dev):
+        raise ValueError(f"zigzag needs seq_len divisible by 2*n_dev "
+                         f"({2 * n_dev}), got {seq_len}")
+    c = seq_len // (2 * n_dev)
+    order = []
+    for i in range(n_dev):
+        order.extend(range(i * c, (i + 1) * c))
+        order.extend(range((2 * n_dev - 1 - i) * c,
+                           (2 * n_dev - i) * c))
+    perm = np.asarray(order)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size)
+    return perm, inv
+
+
+def _zigzag_local(q, k, v, rng, axis_name: str, scale: Optional[float],
+                  dropout_rate: float = 0.0,
+                  batch_axis: Optional[str] = None):
+    """Per-device zigzag body. Local q/k/v rows are the chunk pair
+    (idx, 2n-1-idx); each ring step computes only the causally-needed
+    chunk products:
+
+      A: q_early x kv_early   -- needed iff kv owner <= idx
+      B: q_late  x kv_early   -- always needed (late attends all early)
+      C: q_late  x kv_late    -- needed iff kv owner >= idx
+
+    (q_early x kv_late is never needed: every late chunk sits after
+    every early chunk.) A and C toggle via per-core ``lax.cond``, so
+    masked tiles cost a branch, not a matmul."""
+    n_dev = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if rng is not None and batch_axis is not None:
+        rng = jax.random.fold_in(rng, lax.axis_index(batch_axis))
+    b, l2, h, d = q.shape
+    c = l2 // 2
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    q32 = q.astype(jnp.float32)
+    q_e, q_l = q32[:, :c], q32[:, c:]
+    off_qe = idx * c
+    off_ql = (2 * n_dev - 1 - idx) * c
+
+    def empty_state():
+        return (jnp.full((b, h, c), NEG_INF, jnp.float32),
+                jnp.zeros((b, h, c), jnp.float32),
+                jnp.zeros((b, c, h, d), jnp.float32))
+
+    def tile(qc, kc, vc, q_off, kv_off, q_chunk, kv_chunk, state):
+        m, lsum, acc = state
+        key = None
+        if rng is not None and dropout_rate > 0.0:
+            # tile key in GLOBAL chunk coordinates (schedule-invariant)
+            key = jax.random.fold_in(
+                rng, q_chunk * 2 * n_dev + kv_chunk)
+        return _block_attn(qc, kc.astype(jnp.float32),
+                           vc.astype(jnp.float32), None, q_off, kv_off,
+                           True, scale, m, lsum, acc,
+                           dropout_rate=dropout_rate, dropout_key=key)
+
+    def step(carry, s):
+        st_e, st_l, k_blk, v_blk = carry
+        owner = (idx - s) % n_dev
+        kv_e, kv_l = k_blk[:, :c], k_blk[:, c:]
+        v_e, v_l = v_blk[:, :c], v_blk[:, c:]
+        off_ke = owner * c
+        off_kl = (2 * n_dev - 1 - owner) * c
+
+        st_e = lax.cond(
+            owner <= idx,
+            lambda st: tile(q_e, kv_e, v_e, off_qe, off_ke,
+                            idx, owner, st),
+            lambda st: st, st_e)
+        st_l = tile(q_l, kv_e, v_e, off_ql, off_ke,
+                    2 * n_dev - 1 - idx, owner, st_l)
+        st_l = lax.cond(
+            owner >= idx,
+            lambda st: tile(q_l, kv_l, v_l, off_ql, off_kl,
+                            2 * n_dev - 1 - idx,
+                            2 * n_dev - 1 - owner, st),
+            lambda st: st, st_l)
+        perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (st_e, st_l, k_nxt, v_nxt), None
+
+    init = (empty_state(), empty_state(), k, v)
+    (st_e, st_l, _, _), _ = lax.scan(step, init, jnp.arange(n_dev))
+
+    def finish(state):
+        m, lsum, acc = state
+        lsum = jnp.maximum(lsum, 1e-30)
+        return acc / lsum.transpose(0, 2, 1)[..., None]
+
+    out = jnp.concatenate([finish(st_e), finish(st_l)], axis=1)
+    return out.astype(q.dtype)
+
+
+def zigzag_ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
+                          scale: Optional[float] = None,
+                          qkv_spec: Optional[P] = None,
+                          dropout_rate: float = 0.0, dropout_rng=None,
+                          pre_permuted: bool = False):
+    """Exact CAUSAL attention over a zigzag-balanced ring -- ~2x less
+    compute than :func:`ring_attention` with ``causal=True`` on long
+    sequences (see the schedule note above). Same contract: q/k/v are
+    [batch, seq, heads, head_dim] in natural sequence order; the
+    zigzag permutation is applied (and inverted) internally.
+
+    Layout cost: on a seq-sharded mesh the entry/exit permutations are
+    cross-device reshards (3 in, 1 out per call). For a deep stack,
+    hoist the layout once instead: every non-attention layer (FFN, LN,
+    residual) is permutation-equivariant along the sequence, so a
+    model may permute its hidden states with ``_zigzag_chunk_perm``
+    once after the position embedding, run every attention call with
+    ``pre_permuted=True`` (inputs/outputs stay in zigzag layout), and
+    invert once at the top.
+
+    Non-causal attention has no masked tiles to skip; use
+    :func:`ring_attention` there.
+    """
+    n_dev = mesh.shape[axis_name]
+    seq_len = q.shape[1]
+    perm, inv = _zigzag_chunk_perm(seq_len, n_dev)
+    if pre_permuted:
+        return _ring_shard_call(_zigzag_local, q, k, v, mesh,
+                                axis_name, qkv_spec, dropout_rate,
+                                dropout_rng, scale=scale)
+    out = _ring_shard_call(_zigzag_local, q[:, perm], k[:, perm],
+                           v[:, perm], mesh, axis_name, qkv_spec,
+                           dropout_rate, dropout_rng, scale=scale)
+    return out[:, inv]

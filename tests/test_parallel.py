@@ -272,3 +272,135 @@ class TestRingAttentionDropout:
         g = jax.grad(loss)(q)
         assert np.isfinite(np.asarray(g)).all()
         assert np.abs(np.asarray(g)).max() > 0
+
+
+class TestZigzagRingAttention:
+    """Load-balanced causal ring schedule: exactness vs dense causal
+    attention and vs the contiguous ring, grads, and layout guards."""
+
+    def _qkv(self, b=2, s=32, h=2, d=8, seed=0):
+        rng = np.random.RandomState(seed)
+        return (jnp.asarray(rng.randn(b, s, h, d), jnp.float32),
+                jnp.asarray(rng.randn(b, s, h, d), jnp.float32),
+                jnp.asarray(rng.randn(b, s, h, d), jnp.float32))
+
+    def _dense(self, q, k, v):
+        s, d = q.shape[1], q.shape[3]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+        mask = np.tril(np.ones((s, s), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        return jnp.einsum("bhqk,bkhd->bqhd",
+                          jax.nn.softmax(logits, -1), v)
+
+    @pytest.mark.parametrize("axes,s", [
+        ({"seq": 8}, 32), ({"seq": 8}, 64), ({"data": 2, "seq": 4}, 40)])
+    def test_matches_dense_causal(self, axes, s):
+        from analytics_zoo_tpu.parallel.ring_attention import (
+            zigzag_ring_attention)
+
+        mesh = create_mesh(dict(axes))
+        q, k, v = self._qkv(s=s)
+        out = zigzag_ring_attention(q, k, v, mesh, axis_name="seq")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._dense(q, k, v)),
+                                   atol=2e-5)
+
+    def test_matches_contiguous_ring(self):
+        from analytics_zoo_tpu.parallel.ring_attention import (
+            zigzag_ring_attention)
+
+        mesh = create_mesh({"seq": 8})
+        q, k, v = self._qkv(s=48, seed=3)
+        zig = zigzag_ring_attention(q, k, v, mesh, axis_name="seq")
+        contig = ring_attention(q, k, v, mesh, axis_name="seq",
+                                causal=True)
+        np.testing.assert_allclose(np.asarray(zig), np.asarray(contig),
+                                   atol=2e-5)
+
+    def test_grads_flow(self):
+        from analytics_zoo_tpu.parallel.ring_attention import (
+            zigzag_ring_attention)
+
+        mesh = create_mesh({"seq": 8})
+        q, k, v = self._qkv(s=32, seed=4)
+
+        def loss(qq):
+            return jnp.sum(zigzag_ring_attention(
+                qq, k, v, mesh, axis_name="seq") ** 2)
+
+        g = jax.grad(loss)(q)
+        g_ref = jax.grad(
+            lambda qq: jnp.sum(self._dense(qq, k, v) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   atol=5e-4)
+
+    def test_dropout_deterministic_and_different_keys(self):
+        from analytics_zoo_tpu.parallel.ring_attention import (
+            zigzag_ring_attention)
+
+        mesh = create_mesh({"seq": 8})
+        q, k, v = self._qkv(s=32, seed=5)
+        k1 = jax.random.PRNGKey(1)
+        a = zigzag_ring_attention(q, k, v, mesh, axis_name="seq",
+                                  dropout_rate=0.3, dropout_rng=k1)
+        a2 = zigzag_ring_attention(q, k, v, mesh, axis_name="seq",
+                                   dropout_rate=0.3, dropout_rng=k1)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(a2))
+        b = zigzag_ring_attention(q, k, v, mesh, axis_name="seq",
+                                  dropout_rate=0.3,
+                                  dropout_rng=jax.random.PRNGKey(2))
+        assert np.abs(np.asarray(a) - np.asarray(b)).max() > 1e-3
+
+    def test_rejects_indivisible_seq(self):
+        from analytics_zoo_tpu.parallel.ring_attention import (
+            zigzag_ring_attention)
+
+        mesh = create_mesh({"seq": 8})
+        q, k, v = self._qkv(s=24)  # 24 % 16 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            zigzag_ring_attention(q, k, v, mesh, axis_name="seq")
+
+    def test_transformer_causal_seq_axis_uses_zigzag(self):
+        """The GPT-style stack on a seq mesh routes causal attention
+        through the zigzag schedule and still matches the dense run."""
+        from analytics_zoo_tpu.common.context import (
+            init_zoo_context, stop_orca_context)
+        from analytics_zoo_tpu.keras.layers.transformer import (
+            TransformerModule)
+
+        stop_orca_context()
+        try:
+            init_zoo_context(mesh_shape={"seq": 8})
+            ids = np.random.RandomState(6).randint(
+                0, 32, (2, 32)).astype(np.int32)
+            tm = TransformerModule(vocab=32, seq_len=32, hidden_size=16,
+                                   n_head=2, n_block=1, seq_axis="seq")
+            tvars = tm.init(jax.random.PRNGKey(0), ids)
+            out_sp = np.asarray(jax.jit(tm.apply)(tvars, ids))
+        finally:
+            stop_orca_context()
+        try:
+            init_zoo_context(mesh_shape={"data": 8})
+            tm2 = TransformerModule(vocab=32, seq_len=32,
+                                    hidden_size=16, n_head=2,
+                                    n_block=1, seq_axis=None)
+            out_dense = np.asarray(jax.jit(tm2.apply)(tvars, ids))
+        finally:
+            stop_orca_context()
+        np.testing.assert_allclose(out_sp, out_dense, atol=2e-4)
+
+    def test_pre_permuted_layout(self):
+        """pre_permuted=True consumes/produces zigzag-layout arrays:
+        permute once outside, call with the flag, invert once."""
+        from analytics_zoo_tpu.parallel.ring_attention import (
+            _zigzag_chunk_perm, zigzag_ring_attention)
+
+        mesh = create_mesh({"seq": 8})
+        q, k, v = self._qkv(s=32, seed=7)
+        perm, inv = _zigzag_chunk_perm(32, 8)
+        out_z = zigzag_ring_attention(
+            q[:, perm], k[:, perm], v[:, perm], mesh, axis_name="seq",
+            pre_permuted=True)
+        out = np.asarray(out_z)[:, inv]
+        np.testing.assert_allclose(out, np.asarray(self._dense(q, k, v)),
+                                   atol=2e-5)

@@ -267,3 +267,57 @@ class TestManager:
         assert not (sdir / "broken.json").exists()
         assert manager._alive(-1) is False
         assert manager._alive(0) is False
+
+
+class TestHttpImageIngestion:
+    def test_b64_jpeg_through_live_frontend(self):
+        """End-to-end: base64-JPEG in the /predict body, decoded
+        server-side, predicted, JSON back (the reference's
+        FrontEndApp + PreProcessing.decodeImage flow)."""
+        import base64
+        import io
+
+        from PIL import Image
+
+        class ShapeModel:
+            def predict(self, x):
+                # decoded images arrive stacked [N, H, W, 3] uint8
+                assert x.dtype == np.uint8 and x.ndim == 4
+                return x.astype(np.float32).mean(axis=(1, 2, 3))
+
+        rng = np.random.RandomState(3)
+        arr = rng.randint(0, 255, (16, 16, 3), np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+        b64 = base64.b64encode(buf.getvalue()).decode()
+
+        in_q = InputQueue()
+        out_q = OutputQueue()
+        worker = ServingWorker(ShapeModel(), in_q, out_q,
+                               batch_size=2, timeout_ms=1.0).start()
+        fe = HttpFrontend(in_q, out_q, worker=worker).start()
+        try:
+            body = json.dumps({"inputs": {"image": {"b64": b64}}}) \
+                .encode()
+            req = urllib.request.Request(
+                fe.address + "/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                out = json.loads(r.read())
+            decoded = np.asarray(
+                Image.open(io.BytesIO(buf.getvalue())).convert("RGB"),
+                np.float32)
+            np.testing.assert_allclose(out["predictions"]["output"],
+                                       decoded.mean(), rtol=1e-5)
+            # malformed base64 -> 400, server stays up
+            bad = json.dumps({"inputs": {"image": {"b64": "!!!"}}}) \
+                .encode()
+            req = urllib.request.Request(
+                fe.address + "/predict", data=bad,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 400
+        finally:
+            fe.stop()
+            worker.stop()
