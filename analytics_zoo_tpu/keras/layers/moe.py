@@ -1,0 +1,169 @@
+"""Mixture-of-experts FFN with expert parallelism.
+
+New capability relative to the reference (data-parallel only, SURVEY.md
+section 2.3: "no tensor/pipeline/sequence/expert/context parallelism
+anywhere"). Completes the parallelism alphabet next to dp/tp/sp/pp:
+
+- **Dense path** (no mesh axis): every expert runs on every token and
+  the top-k gate weights select -- the exact "dense MoE" computation,
+  used as the numeric reference and the small-scale fallback.
+- **Expert-parallel path**: expert parameters shard over a mesh axis
+  (one slice of experts per device). Each device computes ONLY its
+  resident experts on the (replicated) token stream, gates zero out
+  non-selected experts, and one ``psum`` over the expert axis merges
+  contributions -- exact equality with the dense path by construction.
+  This is the broadcast-tokens EP layout: comm is a single psum of
+  activations over ICI; the all-to-all token-dispatch layout (capacity
+  factors, token dropping) trades exactness for bandwidth and is
+  intentionally not what this layer does.
+
+The router is a standard softmax top-k with renormalized gates and the
+switch-transformer load-balance auxiliary loss, sown into the
+``losses`` collection as ``moe_aux_loss`` (fetch with
+``mutable=["losses"]`` and add it to the objective).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.activations import get as get_activation
+from analytics_zoo_tpu.keras.layers.base import KerasLayer
+
+__all__ = ["MoEFFN", "MoE"]
+
+
+class MoEFFN(nn.Module):
+    """Top-k routed expert FFN band: x [B, L, H] -> [B, L, H].
+
+    Args:
+      hidden_size / intermediate_size: per-expert FFN dims.
+      n_experts: expert count; must divide by the expert-axis size
+        when expert parallelism engages.
+      top_k: experts per token (1 = switch routing, 2 = classic MoE).
+      expert_axis: mesh axis name to shard experts over; engages when
+        the context mesh carries that axis with size > 1 dividing
+        ``n_experts``. None = always dense.
+      aux_weight: multiplier folded into the sown load-balance loss.
+    """
+
+    hidden_size: int
+    intermediate_size: int
+    n_experts: int
+    top_k: int = 2
+    expert_axis: Optional[str] = None
+    activation: str = "gelu"
+    aux_weight: float = 0.01
+    dtype: Any = jnp.float32
+
+    def _act(self, h):
+        return get_activation(self.activation)(h)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.top_k < 1 or self.top_k > self.n_experts:
+            raise ValueError(
+                f"top_k must be in [1, {self.n_experts}], "
+                f"got {self.top_k}")
+        h = x.shape[-1]
+        e = self.n_experts
+        # router stays fp32: tiny matmul, and gate ordering decides
+        # discrete routing -- bf16 ties would flap expert assignment
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)          # [B, L, E]
+        top_p, top_idx = jax.lax.top_k(probs, self.top_k)
+        top_p = top_p / jnp.maximum(
+            jnp.sum(top_p, -1, keepdims=True), 1e-9)
+        # dense gate map [B, L, E]: renormalized weight where selected
+        onehot = jax.nn.one_hot(top_idx, e, dtype=probs.dtype)
+        gates = jnp.einsum("blk,blke->ble", top_p, onehot)
+
+        # switch-transformer load-balance loss: E * sum_e f_e * p_e
+        # (f = fraction of tokens routed to e, p = mean router prob)
+        frac = jnp.mean(jnp.sum(onehot, axis=2), axis=(0, 1))  # [E]
+        mean_p = jnp.mean(probs, axis=(0, 1))                  # [E]
+        aux = self.aux_weight * e * jnp.sum(frac * mean_p)
+        self.sow("losses", "moe_aux_loss", aux)
+
+        # stacked expert params [E, ...] -- shardable over expert_axis
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (e, h, self.intermediate_size))
+        bi = self.param("bi", nn.initializers.zeros,
+                        (e, self.intermediate_size))
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (e, self.intermediate_size, h))
+        bo = self.param("bo", nn.initializers.zeros, (e, h))
+
+        xc = x.astype(self.dtype)
+        gc = gates.astype(self.dtype)
+
+        def experts_contrib(wi_s, bi_s, wo_s, bo_s, gates_s):
+            """Sum of gated expert outputs for an expert slice."""
+            hmid = self._act(
+                jnp.einsum("blh,ehm->eblm", xc, wi_s)
+                + bi_s[:, None, None])
+            y = (jnp.einsum("eblm,emh->eblh", hmid, wo_s)
+                 + bo_s[:, None, None])
+            return jnp.einsum("ble,eblh->blh", gates_s, y)
+
+        ep_size = 0
+        if self.expert_axis is not None:
+            from analytics_zoo_tpu.parallel.mesh import (
+                default_mesh, mesh_axis_size)
+
+            mesh = default_mesh()
+            if self.expert_axis in mesh.axis_names:
+                ep_size = mesh_axis_size(mesh, self.expert_axis)
+        if ep_size > 1 and e % ep_size == 0:
+            from jax.sharding import PartitionSpec as P
+
+            axis = self.expert_axis
+
+            def local(wi_s, bi_s, wo_s, bo_s, gates_s):
+                out = experts_contrib(wi_s, bi_s, wo_s, bo_s, gates_s)
+                # every device contributed only its resident experts;
+                # the psum over the expert axis completes the routed sum
+                return jax.lax.psum(out, axis)
+
+            espec = P(axis)
+            out = jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(espec, espec, espec, espec,
+                          P(None, None, axis)),
+                out_specs=P(), check_vma=False)(
+                wi, bi, wo, bo, gc)
+        else:
+            out = experts_contrib(wi, bi, wo, bo, gc)
+        return out.astype(x.dtype)
+
+
+class MoE(KerasLayer):
+    """Keras-layer wrapper for :class:`MoEFFN`."""
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 n_experts: int, top_k: int = 2,
+                 expert_axis: Optional[str] = None,
+                 activation: str = "gelu", aux_weight: float = 0.01,
+                 dtype: Any = jnp.float32, **kwargs):
+        super().__init__(**kwargs)
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.n_experts = n_experts
+        self.top_k = top_k
+        self.expert_axis = expert_axis
+        self.activation = activation
+        self.aux_weight = aux_weight
+        self.dtype = dtype
+
+    def _make_module(self):
+        return MoEFFN(hidden_size=self.hidden_size,
+                      intermediate_size=self.intermediate_size,
+                      n_experts=self.n_experts, top_k=self.top_k,
+                      expert_axis=self.expert_axis,
+                      activation=self.activation,
+                      aux_weight=self.aux_weight, dtype=self.dtype)
