@@ -70,6 +70,10 @@ class MoEFFN(nn.Module):
                 f"top_k must be in [1, {self.n_experts}], "
                 f"got {self.top_k}")
         h = x.shape[-1]
+        if h != self.hidden_size:
+            raise ValueError(
+                f"input feature dim {h} != hidden_size "
+                f"{self.hidden_size}")
         e = self.n_experts
         # router stays fp32: tiny matmul, and gate ordering decides
         # discrete routing -- bf16 ties would flap expert assignment
@@ -102,16 +106,20 @@ class MoEFFN(nn.Module):
         xc = x.astype(self.dtype)
         gc = gates.astype(self.dtype)
 
-        def experts_contrib(wi_s, bi_s, wo_s, bo_s, gates_s):
-            """Sum of gated expert outputs for an expert slice."""
+        def experts_contrib(x_s, wi_s, bi_s, wo_s, bo_s, gates_s):
+            """Sum of gated expert outputs for an expert slice; expert
+            params cast to the compute dtype (params stay fp32)."""
+            wi_c = wi_s.astype(self.dtype)
+            wo_c = wo_s.astype(self.dtype)
             hmid = self._act(
-                jnp.einsum("blh,ehm->eblm", xc, wi_s)
-                + bi_s[:, None, None])
-            y = (jnp.einsum("eblm,emh->eblh", hmid, wo_s)
-                 + bo_s[:, None, None])
+                jnp.einsum("blh,ehm->eblm", x_s, wi_c)
+                + bi_s.astype(self.dtype)[:, None, None])
+            y = (jnp.einsum("eblm,emh->eblh", hmid, wo_c)
+                 + bo_s.astype(self.dtype)[:, None, None])
             return jnp.einsum("ble,eblh->blh", gates_s, y)
 
         ep_size = 0
+        mesh = None
         if self.expert_axis is not None:
             from analytics_zoo_tpu.parallel.mesh import (
                 default_mesh, mesh_axis_size)
@@ -121,11 +129,19 @@ class MoEFFN(nn.Module):
                 ep_size = mesh_axis_size(mesh, self.expert_axis)
         if ep_size > 1 and e % ep_size == 0:
             from jax.sharding import PartitionSpec as P
+            from analytics_zoo_tpu.parallel.mesh import mesh_axis_size
 
             axis = self.expert_axis
+            # batch stays sharded over the data axis (dp x ep): each
+            # device computes local_batch x local_experts, the psum
+            # runs over the expert axis only
+            data = ("data" if "data" in mesh.axis_names
+                    and x.shape[0] % mesh_axis_size(mesh, "data") == 0
+                    else None)
 
-            def local(wi_s, bi_s, wo_s, bo_s, gates_s):
-                out = experts_contrib(wi_s, bi_s, wo_s, bo_s, gates_s)
+            def local(x_s, wi_s, bi_s, wo_s, bo_s, gates_s):
+                out = experts_contrib(x_s, wi_s, bi_s, wo_s, bo_s,
+                                      gates_s)
                 # every device contributed only its resident experts;
                 # the psum over the expert axis completes the routed sum
                 return jax.lax.psum(out, axis)
@@ -133,12 +149,12 @@ class MoEFFN(nn.Module):
             espec = P(axis)
             out = jax.shard_map(
                 local, mesh=mesh,
-                in_specs=(espec, espec, espec, espec,
-                          P(None, None, axis)),
-                out_specs=P(), check_vma=False)(
-                wi, bi, wo, bo, gc)
+                in_specs=(P(data, None, None), espec, espec, espec,
+                          espec, P(data, None, axis)),
+                out_specs=P(data, None, None), check_vma=False)(
+                xc, wi, bi, wo, bo, gc)
         else:
-            out = experts_contrib(wi, bi, wo, bo, gc)
+            out = experts_contrib(xc, wi, bi, wo, bo, gc)
         return out.astype(x.dtype)
 
 

@@ -90,10 +90,20 @@ def _stage(profiler, name: str):
     return contextlib.nullcontext()
 
 
+# sow-style collections: written fresh per apply, never carried as
+# state (persisting them would grow the tuples every step)
+_SOW_COLLECTIONS = ("losses", "intermediates")
+
+
 class FlaxModelAdapter:
     """Adapts a flax ``nn.Module`` (or compatible object) to the uniform
     (init, apply) the Estimator drives. Detects a ``train``/``deterministic``
-    flag on ``__call__`` and non-param variable collections (batch_stats)."""
+    flag on ``__call__`` and non-param variable collections (batch_stats).
+
+    Sow collections (``losses``/``intermediates``) are stripped from the
+    stored variables and requested mutable on every training apply, so
+    modules that ``sow`` auxiliary losses (e.g. the MoE load-balance
+    loss) surface them per step without accumulating state."""
 
     def __init__(self, module):
         self.module = module
@@ -114,18 +124,23 @@ class FlaxModelAdapter:
         return {}
 
     def init(self, rng, x) -> Dict[str, Any]:
-        return self.module.init({"params": rng, "dropout": rng},
-                                *_call_args(x), **self._mode_kwargs(False))
+        variables = self.module.init({"params": rng, "dropout": rng},
+                                     *_call_args(x),
+                                     **self._mode_kwargs(False))
+        return {k: v for k, v in variables.items()
+                if k not in _SOW_COLLECTIONS}
 
     def apply(self, variables, x, training: bool, rng=None):
         """Returns (preds, new_extra_collections)."""
+        variables = {k: v for k, v in variables.items()
+                     if k not in _SOW_COLLECTIONS}
         mutable = [k for k in variables if k != "params"]
         kwargs = self._mode_kwargs(training)
         rngs = {"dropout": rng} if (training and rng is not None) else None
-        if training and mutable:
+        if training:
             preds, new_extra = self.module.apply(
-                variables, *_call_args(x), rngs=rngs, mutable=mutable,
-                **kwargs)
+                variables, *_call_args(x), rngs=rngs,
+                mutable=mutable + list(_SOW_COLLECTIONS), **kwargs)
             return preds, dict(new_extra)
         preds = self.module.apply(variables, *_call_args(x), rngs=rngs,
                                   **kwargs)
@@ -146,6 +161,10 @@ class Estimator:
       clip_norm: global-L2 gradient clip (ref: tf_optimizer.py:392-396).
       clip_value: symmetric constant clip (-v, v).
       variables: pre-initialized variables (skip lazy init).
+      aux_loss_collections: variable collections whose sown scalars are
+        SUMMED INTO the training objective each step -- how MoE
+        load-balance losses (``moe_aux_loss`` in ``losses``) reach the
+        optimizer. Default: ("losses",).
     """
 
     def __init__(self, model, loss=None, optimizer="adam",
@@ -154,6 +173,7 @@ class Estimator:
                  clip_value: Optional[float] = None,
                  variables: Optional[Dict[str, Any]] = None,
                  param_spec_fn: Optional[Callable] = None,
+                 aux_loss_collections: Sequence[str] = ("losses",),
                  seed: int = 0):
         self.adapter = (model if hasattr(model, "apply")
                         and hasattr(model, "init")
@@ -164,6 +184,7 @@ class Estimator:
                                       clip_norm, clip_value)
         self.metrics: List[Metric] = [resolve_metric(m) for m in metrics]
         self.mesh = mesh or default_mesh()
+        self.aux_loss_collections = tuple(aux_loss_collections)
         self.param_spec_fn = param_spec_fn
         self.seed = seed
         self.variables = variables
@@ -244,13 +265,24 @@ class Estimator:
         import optax
 
         adapter, loss_fn, tx = self.adapter, self.loss_fn, self.tx
+        aux_colls = self.aux_loss_collections
         params = variables.get("params", {})
         extra = {k: v for k, v in variables.items() if k != "params"}
 
         def compute_loss(p):
             preds, new_extra = adapter.apply(
                 {"params": p, **extra}, x, training=True, rng=rng)
-            return loss_fn(preds, y), new_extra
+            loss = loss_fn(preds, y)
+            for coll in aux_colls:
+                if coll in new_extra:
+                    for leaf in jax.tree_util.tree_leaves(
+                            new_extra[coll]):
+                        loss = loss + jnp.sum(leaf)
+            # sown collections are per-step scalars, not model state
+            new_extra = {k: v for k, v in new_extra.items()
+                         if k not in aux_colls
+                         and k not in _SOW_COLLECTIONS}
+            return loss, new_extra
 
         (loss, new_extra), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(params)
