@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from analytics_zoo_tpu.keras.activations import get as get_activation
 from analytics_zoo_tpu.keras.layers.base import KerasLayer
 
-__all__ = ["MoEFFN", "MoE"]
+__all__ = ["MoEFFN", "MoE", "MoETransformerBlock"]
 
 
 class MoEFFN(nn.Module):
@@ -129,7 +129,6 @@ class MoEFFN(nn.Module):
                 ep_size = mesh_axis_size(mesh, self.expert_axis)
         if ep_size > 1 and e % ep_size == 0:
             from jax.sharding import PartitionSpec as P
-            from analytics_zoo_tpu.parallel.mesh import mesh_axis_size
 
             axis = self.expert_axis
             # batch stays sharded over the data axis (dp x ep): each
@@ -183,3 +182,57 @@ class MoE(KerasLayer):
                       expert_axis=self.expert_axis,
                       activation=self.activation,
                       aux_weight=self.aux_weight, dtype=self.dtype)
+
+
+class MoETransformerBlock(nn.Module):
+    """Post-LN transformer block whose FFN is a routed expert band --
+    the standard MoE-transformer layer (attention unchanged, so it
+    composes with the seq_axis ring/zigzag path like any block).
+
+    Interleave with dense ``TransformerBlock``s for the usual
+    every-other-layer MoE stack; the sown ``moe_aux_loss`` reaches the
+    optimizer through the Estimator's ``aux_loss_collections``.
+    """
+
+    hidden_size: int
+    n_head: int
+    intermediate_size: int
+    n_experts: int = 8
+    top_k: int = 2
+    expert_axis: Optional[str] = None
+    activation: str = "gelu"
+    aux_weight: float = 0.01
+    hidden_dropout: float = 0.1
+    attn_dropout: float = 0.1
+    causal: bool = False
+    ln_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None, key_padding_mask=None,
+                 train: bool = False):
+        from analytics_zoo_tpu.keras.layers.transformer import (
+            MultiHeadSelfAttention)
+
+        attn = MultiHeadSelfAttention(
+            self.hidden_size, self.n_head,
+            attn_dropout=self.attn_dropout, causal=self.causal,
+            dtype=self.dtype, seq_axis=self.seq_axis,
+            name="attention")(x, mask=mask,
+                              key_padding_mask=key_padding_mask,
+                              train=train)
+        attn = nn.Dropout(self.hidden_dropout,
+                          deterministic=not train)(attn)
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                         name="ln_attn")(x + attn)
+        h = MoEFFN(hidden_size=self.hidden_size,
+                   intermediate_size=self.intermediate_size,
+                   n_experts=self.n_experts, top_k=self.top_k,
+                   expert_axis=self.expert_axis,
+                   activation=self.activation,
+                   aux_weight=self.aux_weight, dtype=self.dtype,
+                   name="moe_ffn")(x, train=train)
+        h = nn.Dropout(self.hidden_dropout, deterministic=not train)(h)
+        return nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                            name="ln_ffn")(x + h)

@@ -130,14 +130,17 @@ class FlaxModelAdapter:
         return {k: v for k, v in variables.items()
                 if k not in _SOW_COLLECTIONS}
 
-    def apply(self, variables, x, training: bool, rng=None):
-        """Returns (preds, new_extra_collections)."""
+    def apply(self, variables, x, training: bool, rng=None,
+              want_sown: bool = False):
+        """Returns (preds, new_extra_collections). ``want_sown``
+        surfaces the sow collections on an inference apply too (how
+        evaluate() folds MoE aux losses into val_loss)."""
         variables = {k: v for k, v in variables.items()
                      if k not in _SOW_COLLECTIONS}
         mutable = [k for k in variables if k != "params"]
         kwargs = self._mode_kwargs(training)
         rngs = {"dropout": rng} if (training and rng is not None) else None
-        if training:
+        if training or want_sown:
             preds, new_extra = self.module.apply(
                 variables, *_call_args(x), rngs=rngs,
                 mutable=mutable + list(_SOW_COLLECTIONS), **kwargs)
@@ -369,11 +372,41 @@ class Estimator:
             return self._eval_step
         adapter = self.adapter
         metrics = self._eval_metrics()
+        aux_colls = self.aux_loss_collections
+        # only the flax adapter can surface sown aux losses; other
+        # adapters (GraphModel, custom) have none to surface
+        want_sown = bool(aux_colls) and isinstance(adapter,
+                                                   FlaxModelAdapter)
+
+        from analytics_zoo_tpu.learn.metrics import Loss
 
         def step(variables, x, y, w, states):
-            preds, _ = adapter.apply(variables, x, training=False)
-            return [m.update(s, preds, y, weights=w)
-                    for m, s in zip(metrics, states)]
+            if want_sown:
+                preds, extra = adapter.apply(variables, x,
+                                             training=False,
+                                             want_sown=True)
+                aux = jnp.zeros((), jnp.float32)
+                for coll in aux_colls:
+                    for leaf in jax.tree_util.tree_leaves(
+                            extra.get(coll, {})):
+                        aux = aux + jnp.sum(leaf)
+            else:
+                preds, _ = adapter.apply(variables, x, training=False)
+                aux = None
+            out = []
+            for m, s in zip(metrics, states):
+                s = m.update(s, preds, y, weights=w)
+                if aux is not None and isinstance(m, Loss):
+                    # the aux term applies once per sample so the
+                    # streaming mean matches the training objective
+                    # (keras semantics: regularizers count in val_loss)
+                    wsum = (jnp.sum(jnp.asarray(w, jnp.float32))
+                            if w is not None else
+                            jnp.asarray(_batch_size_of(preds),
+                                        jnp.float32))
+                    s = {**s, "total": s["total"] + aux * wsum}
+                out.append(s)
+            return out
 
         self._eval_step = jax.jit(step)
         return self._eval_step
@@ -779,6 +812,11 @@ def recompiled(old: Optional["Estimator"], model, **kwargs) -> "Estimator":
         est.global_step = old.global_step
         est.epoch = old.epoch
     return est
+
+
+def _batch_size_of(preds) -> int:
+    leaf = jax.tree_util.tree_leaves(preds)[0]
+    return leaf.shape[0]
 
 
 def _is_flax_module(obj) -> bool:

@@ -254,3 +254,95 @@ class TestMoEThroughEstimator:
         m = MoEFFN(hidden_size=16, intermediate_size=8, n_experts=2)
         with pytest.raises(ValueError, match="hidden_size"):
             m.init(jax.random.PRNGKey(0), jnp.zeros((1, 2, 8)))
+
+
+class TestMoETransformerBlock:
+    def test_forward_and_trains(self):
+        from analytics_zoo_tpu.keras.layers import MoETransformerBlock
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        import flax.linen as nn
+
+        class TinyMoELM(nn.Module):
+            @nn.compact
+            def __call__(self, ids, train: bool = False):
+                h = nn.Embed(32, 16)(ids.astype(jnp.int32))
+                h = MoETransformerBlock(
+                    hidden_size=16, n_head=2, intermediate_size=32,
+                    n_experts=4, top_k=2, causal=True,
+                    hidden_dropout=0.0, attn_dropout=0.0)(h,
+                                                          train=train)
+                return nn.Dense(32)(h)
+
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 32, (16, 8)).astype(np.int32)
+        y = np.roll(x, -1, axis=1).astype(np.int32)
+        def token_ce(preds, labels):
+            logp = jax.nn.log_softmax(
+                preds.reshape(-1, preds.shape[-1]).astype(jnp.float32))
+            flat = labels.reshape(-1).astype(jnp.int32)
+            return -jnp.mean(logp[jnp.arange(flat.size), flat])
+
+        est = Estimator(TinyMoELM(), loss=token_ce,
+                        optimizer="adam", seed=0)
+        hist = est.fit((x, y), batch_size=8, epochs=4)
+        losses = [h["loss"] for h in hist]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+
+    def test_ep_block_matches_dense_block(self):
+        from analytics_zoo_tpu.keras.layers import MoETransformerBlock
+
+        x = np.random.RandomState(1).randn(2, 8, 16).astype(np.float32)
+        dense = MoETransformerBlock(hidden_size=16, n_head=2,
+                                    intermediate_size=32, n_experts=8,
+                                    hidden_dropout=0.0,
+                                    attn_dropout=0.0)
+        v = dense.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        ref, _ = dense.apply(v, jnp.asarray(x), mutable=["losses"])
+        stop_orca_context()
+        try:
+            init_zoo_context(mesh_shape={"expert": 8})
+            ep = MoETransformerBlock(hidden_size=16, n_head=2,
+                                     intermediate_size=32, n_experts=8,
+                                     expert_axis="expert",
+                                     hidden_dropout=0.0,
+                                     attn_dropout=0.0)
+            out, _ = jax.jit(
+                lambda vv, xx: ep.apply(vv, xx, mutable=["losses"]))(
+                v, jnp.asarray(x))
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            stop_orca_context()
+
+    def test_val_loss_includes_aux_term(self):
+        """evaluate()'s loss must measure the same objective training
+        does (keras semantics: regularizers count in val loss)."""
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        rng = np.random.RandomState(2)
+        x = rng.randn(16, 4, 8).astype(np.float32)
+        y = rng.randint(0, 2, 16).astype(np.int32)
+
+        def run(aux_weight):
+            import flax.linen as nn
+
+            class M(nn.Module):
+                @nn.compact
+                def __call__(self, xx, train: bool = False):
+                    h = MoEFFN(hidden_size=8, intermediate_size=8,
+                               n_experts=4, top_k=1,
+                               aux_weight=aux_weight)(xx, train=train)
+                    return nn.Dense(2)(h.mean(axis=1))
+
+            est = Estimator(M(),
+                            loss="sparse_categorical_crossentropy",
+                            optimizer="sgd", seed=0)
+            est.fit((x, y), batch_size=8, epochs=1)
+            return est.evaluate((x, y), batch_size=8)["loss"]
+
+        plain = run(0.0)
+        with_aux = run(10.0)
+        # a large aux weight must show up in the evaluated loss
+        assert with_aux > plain + 1.0, (plain, with_aux)
