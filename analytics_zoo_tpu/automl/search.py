@@ -245,6 +245,11 @@ class SearchEngine:
                 eff = min(budget, int(self.configs[i].get("epochs", 1)))
                 if eff != ran_epochs[i]:  # budget already covered: skip
                     todo.append((i, eff))
+            # the rung config (and hence TrialOutput.config) carries
+            # the epochs the stored model state ACTUALLY trains (the
+            # rung budget), not the requested full budget -- pipeline
+            # metadata must match the trained state (ADVICE r4); the
+            # original ask is reported in extras["requested_epochs"]
             rung_cfgs = [dict(self.configs[i], epochs=eff)
                          for i, eff in todo]
             outs = self._run_trials(rung_cfgs)
@@ -252,7 +257,8 @@ class SearchEngine:
             for (i, eff), t in zip(todo, outs):
                 t.extras["rung"] = rung
                 t.extras["rung_epochs"] = eff
-                t.config = self.configs[i]  # report the full budget
+                t.extras["requested_epochs"] = int(
+                    self.configs[i].get("epochs", 1))
                 results[i] = t
                 ran_epochs[i] = eff
             scored = sorted(

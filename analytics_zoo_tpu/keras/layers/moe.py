@@ -7,15 +7,24 @@ anywhere"). Completes the parallelism alphabet next to dp/tp/sp/pp:
 - **Dense path** (no mesh axis): every expert runs on every token and
   the top-k gate weights select -- the exact "dense MoE" computation,
   used as the numeric reference and the small-scale fallback.
-- **Expert-parallel path**: expert parameters shard over a mesh axis
-  (one slice of experts per device). Each device computes ONLY its
-  resident experts on the (replicated) token stream, gates zero out
-  non-selected experts, and one ``psum`` over the expert axis merges
-  contributions -- exact equality with the dense path by construction.
-  This is the broadcast-tokens EP layout: comm is a single psum of
-  activations over ICI; the all-to-all token-dispatch layout (capacity
-  factors, token dropping) trades exactness for bandwidth and is
-  intentionally not what this layer does.
+- **Expert-parallel, broadcast layout** (``layout="broadcast"``):
+  expert parameters shard over a mesh axis (one slice of experts per
+  device). Each device computes ONLY its resident experts on the
+  (replicated) token stream, gates zero out non-selected experts, and
+  one ``psum`` over the expert axis merges contributions -- exact
+  equality with the dense path by construction. Comm is a single psum
+  of activations over ICI, but every expert still runs on every token:
+  it shards expert MEMORY, not compute.
+- **Expert-parallel, dispatch layout** (``layout="dispatch"``): the
+  GShard/Switch all-to-all layout. Tokens shard over (data x expert)
+  devices; each source device packs per-expert capacity buffers
+  (``capacity_factor``; overflow tokens are DROPPED -- slot-major
+  priority, first choices ahead of second), one ``all_to_all`` over
+  the expert axis carries each buffer to the expert's home device,
+  each expert runs on only its ~n*k/E routed tokens, and the inverse
+  ``all_to_all`` + combine weights scatter results back. Compute AND
+  memory scale 1/ep; kept tokens match the dense path exactly, dropped
+  tokens contribute zero (the residual path carries them).
 
 The router is a standard softmax top-k with renormalized gates and the
 switch-transformer load-balance auxiliary loss, sown into the
@@ -48,6 +57,13 @@ class MoEFFN(nn.Module):
       expert_axis: mesh axis name to shard experts over; engages when
         the context mesh carries that axis with size > 1 dividing
         ``n_experts``. None = always dense.
+      layout: "broadcast" (exact, shards memory only) or "dispatch"
+        (all_to_all token routing with ``capacity_factor``; shards
+        compute too, overflow tokens drop). Dispatch requires the
+        batch dim to divide by data_size * ep_size.
+      capacity_factor: dispatch-layout expert capacity multiplier:
+        each source device offers C = ceil(cf * n_local * top_k / E)
+        slots per expert.
       aux_weight: multiplier folded into the sown load-balance loss.
     """
 
@@ -56,6 +72,8 @@ class MoEFFN(nn.Module):
     n_experts: int
     top_k: int = 2
     expert_axis: Optional[str] = None
+    layout: str = "broadcast"
+    capacity_factor: float = 1.25
     activation: str = "gelu"
     aux_weight: float = 0.01
     dtype: Any = jnp.float32
@@ -69,6 +87,9 @@ class MoEFFN(nn.Module):
             raise ValueError(
                 f"top_k must be in [1, {self.n_experts}], "
                 f"got {self.top_k}")
+        if self.layout not in ("broadcast", "dispatch"):
+            raise ValueError("layout must be broadcast|dispatch, "
+                             f"got {self.layout!r}")
         h = x.shape[-1]
         if h != self.hidden_size:
             raise ValueError(
@@ -127,7 +148,11 @@ class MoEFFN(nn.Module):
             mesh = default_mesh()
             if self.expert_axis in mesh.axis_names:
                 ep_size = mesh_axis_size(mesh, self.expert_axis)
-        if ep_size > 1 and e % ep_size == 0:
+        if ep_size > 1 and e % ep_size == 0 \
+                and self.layout == "dispatch":
+            out = self._dispatch_ep(xc, wi, bi, wo, bo, top_idx, top_p,
+                                    mesh, ep_size)
+        elif ep_size > 1 and e % ep_size == 0:
             from jax.sharding import PartitionSpec as P
 
             axis = self.expert_axis
@@ -156,6 +181,86 @@ class MoEFFN(nn.Module):
             out = experts_contrib(xc, wi, bi, wo, bo, gc)
         return out.astype(x.dtype)
 
+    def _dispatch_ep(self, xc, wi, bi, wo, bo, top_idx, top_p, mesh,
+                     ep_size):
+        """GShard/Switch all-to-all dispatch: tokens shard over
+        (data x expert) devices, experts shard over the expert axis,
+        one all_to_all each way moves capacity buffers, not the full
+        token stream. Slot-major priority queueing: across the local
+        token shard, every first-choice assignment ranks ahead of any
+        second choice; assignments past the per-expert capacity are
+        dropped (contribute zero -- the caller's residual carries the
+        token)."""
+        import math
+
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from analytics_zoo_tpu.parallel.mesh import mesh_axis_size
+
+        axis = self.expert_axis
+        e, k = self.n_experts, self.top_k
+        e_loc = e // ep_size
+        data = ("data" if "data" in mesh.axis_names
+                and mesh_axis_size(mesh, "data") > 1 else None)
+        d_size = mesh_axis_size(mesh, "data") if data else 1
+        shards = d_size * ep_size
+        if xc.shape[0] % shards != 0:
+            raise ValueError(
+                f"dispatch MoE shards tokens over batch: batch "
+                f"{xc.shape[0]} must divide by data*expert = {shards}")
+        n_local = (xc.shape[0] // shards) * xc.shape[1]
+        cap = max(1, math.ceil(self.capacity_factor * n_local * k / e))
+        act, dtype = self._act, self.dtype
+
+        def local(x_s, wi_s, bi_s, wo_s, bo_s, idx_s, w_s):
+            b, L, h = x_s.shape
+            n = b * L
+            xf = x_s.reshape(n, h)
+            sel = idx_s.reshape(n, k)
+            w = w_s.reshape(n, k).astype(dtype)
+            # slot-major priority: flatten (slot, token) so slot 0 of
+            # every token enqueues before any slot 1 (Switch ordering)
+            oh = jax.nn.one_hot(sel, e, dtype=jnp.int32)   # [n, k, E]
+            ohf = oh.transpose(1, 0, 2).reshape(k * n, e)
+            pos = jnp.cumsum(ohf, axis=0) - ohf            # queue pos
+            keep = (pos < cap) & (ohf > 0)
+            slot = jax.nn.one_hot(jnp.minimum(pos, cap - 1), cap,
+                                  dtype=dtype)             # [k*n,E,C]
+            disp_k = (keep[..., None] * slot).reshape(k, n, e, cap)
+            dispatch = disp_k.sum(0)                       # [n, E, C]
+            combine = jnp.einsum("knec,nk->nec", disp_k, w)
+
+            # pack per-expert capacity buffers and ship each to the
+            # expert's home device; tiled all_to_all over dim 0 is an
+            # involution, so the same call routes results back
+            buf = jnp.einsum("nec,nh->ech", dispatch, xf)  # [E, C, H]
+            buf = lax.all_to_all(buf, axis, 0, 0, tiled=True)
+            # received layout: dim 0 = (source peer, local expert)
+            z = (buf.reshape(ep_size, e_loc, cap, h)
+                 .transpose(1, 0, 2, 3).reshape(e_loc, ep_size * cap,
+                                                h))
+            hmid = act(jnp.einsum("egh,ehm->egm", z,
+                                  wi_s.astype(dtype))
+                       + bi_s.astype(dtype)[:, None])
+            y = (jnp.einsum("egm,emh->egh", hmid, wo_s.astype(dtype))
+                 + bo_s.astype(dtype)[:, None])
+            y = (y.reshape(e_loc, ep_size, cap, h)
+                 .transpose(1, 0, 2, 3).reshape(e, cap, h))
+            y = lax.all_to_all(y, axis, 0, 0, tiled=True)
+            out = jnp.einsum("nec,ech->nh", combine, y)
+            return out.reshape(b, L, h)
+
+        tspec = P((data, axis) if data else axis, None, None)
+        espec = P(axis)
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(tspec, espec, espec, espec, espec,
+                      P((data, axis) if data else axis, None, None),
+                      P((data, axis) if data else axis, None, None)),
+            out_specs=tspec, check_vma=False)(
+            xc, wi, bi, wo, bo, top_idx, top_p)
+
 
 class MoE(KerasLayer):
     """Keras-layer wrapper for :class:`MoEFFN`."""
@@ -163,6 +268,8 @@ class MoE(KerasLayer):
     def __init__(self, hidden_size: int, intermediate_size: int,
                  n_experts: int, top_k: int = 2,
                  expert_axis: Optional[str] = None,
+                 layout: str = "broadcast",
+                 capacity_factor: float = 1.25,
                  activation: str = "gelu", aux_weight: float = 0.01,
                  dtype: Any = jnp.float32, **kwargs):
         super().__init__(**kwargs)
@@ -171,6 +278,8 @@ class MoE(KerasLayer):
         self.n_experts = n_experts
         self.top_k = top_k
         self.expert_axis = expert_axis
+        self.layout = layout
+        self.capacity_factor = capacity_factor
         self.activation = activation
         self.aux_weight = aux_weight
         self.dtype = dtype
@@ -180,6 +289,8 @@ class MoE(KerasLayer):
                       intermediate_size=self.intermediate_size,
                       n_experts=self.n_experts, top_k=self.top_k,
                       expert_axis=self.expert_axis,
+                      layout=self.layout,
+                      capacity_factor=self.capacity_factor,
                       activation=self.activation,
                       aux_weight=self.aux_weight, dtype=self.dtype)
 
@@ -200,6 +311,8 @@ class MoETransformerBlock(nn.Module):
     n_experts: int = 8
     top_k: int = 2
     expert_axis: Optional[str] = None
+    layout: str = "broadcast"
+    capacity_factor: float = 1.25
     activation: str = "gelu"
     aux_weight: float = 0.01
     hidden_dropout: float = 0.1
@@ -229,7 +342,8 @@ class MoETransformerBlock(nn.Module):
         h = MoEFFN(hidden_size=self.hidden_size,
                    intermediate_size=self.intermediate_size,
                    n_experts=self.n_experts, top_k=self.top_k,
-                   expert_axis=self.expert_axis,
+                   expert_axis=self.expert_axis, layout=self.layout,
+                   capacity_factor=self.capacity_factor,
                    activation=self.activation,
                    aux_weight=self.aux_weight, dtype=self.dtype,
                    name="moe_ffn")(x, train=train)

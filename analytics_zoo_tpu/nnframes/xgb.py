@@ -165,8 +165,16 @@ class XGBModel:
         if hits.get("meta", 1 << 30) < hits.get("learner", 1 << 30):
             model = GradientBoostedTrees.load(p)
         else:
-            from xgboost.sklearn import XGBClassifier as _RealC
-            from xgboost.sklearn import XGBRegressor as _RealR
+            try:
+                from xgboost.sklearn import XGBClassifier as _RealC
+                from xgboost.sklearn import XGBRegressor as _RealR
+            except ImportError as e:
+                raise ImportError(
+                    f"checkpoint {p!r} was saved with the real xgboost "
+                    "library (its JSON carries a 'learner' section), "
+                    "which is not installed in this environment -- "
+                    "install xgboost to load it, or re-train with the "
+                    "built-in GradientBoostedTrees backend") from e
 
             m = re.search(r'"name"\s*:\s*"((?:multi|binary):[^"]*)"',
                           head)

@@ -76,7 +76,7 @@ def _block_attn(q, k, v, bias, q_offset, kv_offset, causal, scale,
 
 def _ring_attn_local(q, k, v, rng, axis_name: str, causal: bool,
                      scale: Optional[float], dropout_rate: float = 0.0,
-                     batch_axis: Optional[str] = None):
+                     batch_axis=None):  # str | tuple[str, ...] | None
     """Per-device body, runs under shard_map with seq-sharded q/k/v."""
     n_dev = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -131,7 +131,15 @@ def _ring_shard_call(local_fn, q, k, v, mesh, axis_name, qkv_spec,
         qkv_spec = P(data, axis_name, None, None)
     dropping = dropout_rng is not None and dropout_rate > 0.0
     batch_axis = qkv_spec[0] if len(qkv_spec) > 0 else None
-    if not isinstance(batch_axis, str):
+    if isinstance(batch_axis, (tuple, list)):
+        # tuple-sharded batch dim, e.g. P(('data','model'), ...):
+        # lax.axis_index accepts the tuple and yields the linearized
+        # shard index, so every batch shard still folds a distinct
+        # dropout key (a bare-string-only check would silently repeat
+        # one mask across shards -- correlated dropout)
+        batch_axis = tuple(batch_axis) if batch_axis and all(
+            isinstance(a, str) for a in batch_axis) else None
+    elif not isinstance(batch_axis, str):
         batch_axis = None
     extra = (dropout_rng,) if dropping else ()
     fn = jax.shard_map(
@@ -223,7 +231,7 @@ def _zigzag_chunk_perm(seq_len: int, n_dev: int):
 
 def _zigzag_local(q, k, v, rng, axis_name: str, scale: Optional[float],
                   dropout_rate: float = 0.0,
-                  batch_axis: Optional[str] = None):
+                  batch_axis=None):  # str | tuple[str, ...] | None
     """Per-device zigzag body. Local q/k/v rows are the chunk pair
     (idx, 2n-1-idx); each ring step computes only the causally-needed
     chunk products:

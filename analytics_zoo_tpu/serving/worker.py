@@ -218,12 +218,18 @@ class ServingWorker:
                     logger.exception("serving: undecodable request "
                                      "dropped: %s", e)
             items, bad_images = decode_image_batch(items)
+        decode_s = time.perf_counter() - self._batch_t0
         n_failed = 0
         for uri, reply, msg in bad_images:
             logger.warning("serving: %s", msg)
             self._push_error(uri, reply, msg)
             n_failed += 1
         groups = self._group_compatible(items)
+        # the decode stage is shared by every signature group of this
+        # cycle: apportion it by group size so a group's "service"
+        # metric neither double-counts earlier groups' decode+prep
+        # time nor charges a 1-item group a 127-item group's decode
+        self._decode_per_item = decode_s / max(1, len(items))
         n = n_failed
         for group in groups:
             try:
@@ -256,6 +262,7 @@ class ServingWorker:
     def _predict_group(self, group) -> int:
         uris = [u for u, _, _ in group]
         replies = [r for _, _, r in group]
+        t0 = time.perf_counter()  # this group's own prep starts here
         with self.timer.timing("stack", batch=len(group)):
             stacked = {
                 k: np.stack([t[k] for _, t, _ in group])
@@ -273,10 +280,11 @@ class ServingWorker:
             for uri, reply in zip(uris, replies):
                 self._push_error(uri, reply, str(e))
             return len(group)
-        # prep time for THIS batch: decode start -> dispatch issued
-        # (stored so the service metric can exclude the pipeline
-        # residency spent while other batches finalize)
-        prep_s = time.perf_counter() - self._batch_t0
+        # prep time for THIS group: its share of the cycle's decode
+        # stage + its own stack/dispatch (stored so the service metric
+        # can exclude pipeline residency while other batches finalize)
+        prep_s = (getattr(self, "_decode_per_item", 0.0) * len(group)
+                  + time.perf_counter() - t0)
         self._inflight.append((uris, replies, preds, n, prep_s))
         return 0  # counted when finalized
 

@@ -23,7 +23,11 @@ CPU (subprocess, cached): the reference is a CPU/MKL framework and
 publishes no absolute numbers (BASELINE.md), so TPU-vs-host-CPU through
 the same code path is the meaningful ratio.
 
-Prints exactly one JSON line:
+Prints exactly one COMPACT JSON line (metrics + short machine keys
+only, kept well under 1.5 KB: the driver records only the last 2,000
+characters of output, so a long line loses its head — the r4 lesson).
+All methodology prose lives in the committed BENCH_NOTES.md, referenced
+by the line's ``notes_file`` key:
   {"metric", "value", "unit", "vs_baseline", "extras": {...}}
 """
 
@@ -65,6 +69,11 @@ SERVING_SECONDS = 8.0
 SERVING_BATCH = 128
 SERVING_DEPTH = 3
 SERVING_WINDOWS = 3
+# a window only counts if the tunnel probe taken right before it meets
+# this floor (MB/s by the 4MiB-device_put probe): r4's 2.2rps record
+# came from a pathological sub-floor window that blind best-of-3 kept
+SERVING_TUNNEL_FLOOR = 8.0
+SERVING_MAX_ATTEMPTS = 8  # keep probing for good windows up to this
 
 CPU_BASELINE_FILE = os.path.join(REPO, ".bench_cpu_baseline.json")
 
@@ -112,14 +121,17 @@ def measure_ncf(batch: int, epochs: int):
     # (BENCH r2/r3 notes), so each epoch is an interleaved timing
     # window and the best one is the variance-proof round-over-round
     # comparator
-    seconds = min(h["seconds"] for h in steady)
+    secs = sorted(h["seconds"] for h in steady)
+    seconds = secs[0]
+    median_seconds = secs[len(secs) // 2]
     samples_per_sec = (n // batch) * batch / seconds
+    median_sps = (n // batch) * batch / median_seconds
 
     # analytic model FLOPs/sample: fwd matmul 2*P_dense, bwd ~2x -> 6x
     p_dense = _dense_params(model.estimator.variables)
     flops_per_sample = 6 * p_dense
     mfu = samples_per_sec * flops_per_sample / _peak()
-    return samples_per_sec, mfu
+    return samples_per_sec, mfu, median_sps
 
 
 def measure_bert(batch: int, seq: int, steps: int, windows: int = 8):
@@ -187,23 +199,28 @@ def measure_resnet(batch: int, steps: int, epochs: int):
                         device_cache=True)
     steady = history[1:] or history
     # best epoch = best interleaved window (chip-variance-proof, same
-    # rationale as measure_bert)
-    seconds = min(h["seconds"] for h in steady)
-    imgs_per_sec = n / seconds
+    # rationale as measure_bert); median kept alongside (ADVICE r4)
+    secs = sorted(h["seconds"] for h in steady)
+    imgs_per_sec = n / secs[0]
+    median_ips = n / secs[len(secs) // 2]
     train_flops_per_img = 3 * 4.1e9
     mfu = imgs_per_sec * train_flops_per_img / _peak()
-    return imgs_per_sec, mfu, history[0]["seconds"]
+    median_mfu = median_ips * train_flops_per_img / _peak()
+    return imgs_per_sec, mfu, history[0]["seconds"], median_mfu
 
 
 def measure_serving(seconds: float, batch: int):
-    """Cluster-serving throughput + latency: launcher-assembled
-    deployment (ResNet-18 classifier, memory queue, micro-batcher),
-    enqueue JPEG-compressed images for a fixed window (the reference's
-    wire format -- base64 JPEG decoded server-side,
-    PreProcessing.scala:83-99), dequeue results, report RPS with the
-    latency HONESTLY SPLIT: client-observed p50/p99 (queue wait +
-    transport included) next to the worker's service-time p50 (decode
-    -> predict -> push, from the in-worker Timer)."""
+    """Cluster-serving throughput + latency (full methodology:
+    BENCH_NOTES.md). Reports a dict with the scoreboard split THREE
+    ways so the number survives any tunnel state:
+    - client-observed rps/p50/p99 over tunnel-floor-ACCEPTED windows
+      (a window only counts if the probe taken right before it meets
+      SERVING_TUNNEL_FLOOR; r4's 2.2rps was a sub-floor window),
+    - the worker's own service-time p50 (host work + un-overlapped
+      device wait, from the in-worker Timer),
+    - ``worker_rps``: tunnel-INDEPENDENT service throughput on
+      pre-staged device-resident uint8 batches (the number a
+      co-located TPU would see)."""
     import io as _io
     import tempfile
 
@@ -230,16 +247,19 @@ def measure_serving(seconds: float, batch: int):
             "http": {"enabled": False},
         })
         try:
-            # the host->device tunnel is the serving ceiling on this
-            # rig AND swings ~5x by the minute -- measure it so the
-            # recorded rps has its denominator next to it
+            # the host->device tunnel is the client-observed ceiling on
+            # this rig AND swings ~5x by the minute -- probe it before
+            # every window and accept only windows above the floor
             probe = np.zeros((4 << 20,), np.uint8)
-            bw = []
-            for _ in range(3):
-                t0 = time.perf_counter()
-                jax.device_put(probe).block_until_ready()
-                bw.append(probe.size / (time.perf_counter() - t0) / 1e6)
-            tunnel_mbps = max(bw)
+
+            def probe_tunnel() -> float:
+                bw = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    jax.device_put(probe).block_until_ready()
+                    bw.append(probe.size /
+                              (time.perf_counter() - t0) / 1e6)
+                return max(bw)
 
             arr = (np.random.RandomState(0).rand(224, 224, 3)
                    * 255).astype(np.uint8)
@@ -287,14 +307,68 @@ def measure_serving(seconds: float, batch: int):
                 p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
                 return rps, p50, p99
 
-            results = [window(w) for w in range(SERVING_WINDOWS)]
-            rps, p50, p99 = max(results, key=lambda r: r[0])
+            accepted = []  # (rps, p50, p99, probed_mbps)
+            rejected = 0
+            degraded = 0
+            for w in range(SERVING_MAX_ATTEMPTS):
+                if len(accepted) >= SERVING_WINDOWS:
+                    break
+                mbps = probe_tunnel()
+                if mbps < SERVING_TUNNEL_FLOOR:
+                    rejected += 1
+                    time.sleep(3.0)  # tunnel swings by the minute
+                    continue
+                accepted.append(window(w) + (mbps,))
+            if not accepted:
+                # every probe failed the floor: record one window
+                # anyway, explicitly flagged degraded (probe evidence
+                # in tunnel_mbps) -- never an empty scoreboard
+                degraded = 1
+                mbps = probe_tunnel()
+                accepted.append(window(SERVING_MAX_ATTEMPTS) + (mbps,))
+            rps, p50, p99, tunnel_mbps = max(accepted,
+                                             key=lambda r: r[0])
+            median_rps = sorted(r[0] for r in accepted)[
+                len(accepted) // 2]
             stages = app.worker.timer.summary()
             svc = stages.get("service", {})
             worker_p50_ms = svc.get("p50_s", svc.get("avg_s", 0)) * 1e3
-            payload_kb = jpeg.size / 1024.0
-            return (rps, p50 * 1e3, p99 * 1e3, worker_p50_ms,
-                    payload_kb, tunnel_mbps, stages)
+            dec = stages.get("decode", {})
+            decode_ms = dec.get("p50_s", dec.get("avg_s", 0)) * 1e3
+
+            # tunnel-INDEPENDENT worker service throughput: the same
+            # jitted forward the worker dispatches (uint8 in, fused
+            # on-device normalization), but on a PRE-STAGED device-
+            # resident batch, outputs left on device. This bounds what
+            # the identical worker serves on a co-located TPU where
+            # the wire is PCIe/ICI rather than this rig's tunnel.
+            # predict_async canonicalizes through np.asarray (a host
+            # pull), so the compiled apply is timed directly
+            model = app.worker.model
+            imgs = np.repeat(arr[None], batch, axis=0)
+            x_dev = jax.device_put(imgs)
+            fn = jax.jit(model._apply_fn)
+            jax.block_until_ready(fn(model.variables, x_dev))
+            rates = []
+            for _ in range(3):
+                iters = 20
+                t0 = time.perf_counter()
+                for _i in range(iters):
+                    out = fn(model.variables, x_dev)
+                jax.block_until_ready(out)
+                rates.append(batch * iters /
+                             (time.perf_counter() - t0))
+            worker_rps = max(rates)
+
+            return {
+                "rps": rps, "median_rps": median_rps,
+                "p50_ms": p50 * 1e3, "p99_ms": p99 * 1e3,
+                "worker_p50_ms": worker_p50_ms,
+                "worker_rps": worker_rps, "decode_ms": decode_ms,
+                "payload_kb": jpeg.size / 1024.0,
+                "tunnel_mbps": tunnel_mbps, "rejected": rejected,
+                "degraded": degraded, "stages": stages,
+            }
         finally:
             app.stop()
 
@@ -326,7 +400,7 @@ def cpu_baseline() -> float:
         "import sys; sys.path.insert(0, %r)\n"
         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
         "import bench\n"
-        "v, _ = bench.measure_ncf(batch=bench.NCF_BATCH, epochs=2)\n"
+        "v = bench.measure_ncf(batch=bench.NCF_BATCH, epochs=2)[0]\n"
         "print('CPU_RESULT', v)\n" % REPO)
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=2400, cwd=REPO)
@@ -340,11 +414,26 @@ def cpu_baseline() -> float:
     raise RuntimeError(f"cpu baseline failed: {out.stderr[-2000:]}")
 
 
+def measure_scaling_virtual(n: int = 8, timeout: float = 900.0):
+    """Run the weak-scaling harness over n virtual CPU devices in a
+    subprocess (this process holds the TPU backend). Validates the
+    SPMD code path + collective layout, not interconnect perf -- the
+    same harness reports ICI efficiency on real multi-chip."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_scaling.py"),
+         "--virtual", str(n), "--per-device-batch", "4096"],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO)
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)["value"]
+    raise RuntimeError(f"scaling harness failed: {out.stderr[-500:]}")
+
+
 def main():
     import jax
 
     n_chips = len(jax.devices())
-    ncf_total, ncf_mfu = measure_ncf(NCF_BATCH, NCF_EPOCHS)
+    ncf_total, ncf_mfu, ncf_median = measure_ncf(NCF_BATCH, NCF_EPOCHS)
     ncf_per_chip = ncf_total / n_chips
     bert_batch = BERT_BATCH
     try:
@@ -362,32 +451,34 @@ def main():
             print(f"warning: bert bench failed: {e2}", file=sys.stderr)
             bert_sps = bert_mfu = bert_median_mfu = None
     try:
-        resnet_ips, resnet_mfu, resnet_epoch1 = measure_resnet(
-            RESNET_BATCH, RESNET_STEPS, RESNET_EPOCHS)
+        resnet_ips, resnet_mfu, resnet_epoch1, resnet_median_mfu = (
+            measure_resnet(RESNET_BATCH, RESNET_STEPS, RESNET_EPOCHS))
     except Exception as e:
         print(f"warning: resnet bench failed: {e}", file=sys.stderr)
         resnet_ips = resnet_mfu = resnet_epoch1 = None
     try:
-        (serving_rps, serving_p50, serving_p99, serving_worker_p50,
-         serving_payload_kb, serving_tunnel_mbps,
-         _stages) = measure_serving(SERVING_SECONDS, SERVING_BATCH)
+        serving = measure_serving(SERVING_SECONDS, SERVING_BATCH)
     except Exception as e:
         print(f"warning: serving bench failed: {e}", file=sys.stderr)
-        serving_rps = serving_p50 = serving_p99 = None
+        serving = None
+    try:
+        scaling_eff = measure_scaling_virtual(8)
+    except Exception as e:
+        print(f"warning: scaling harness failed: {e}", file=sys.stderr)
+        scaling_eff = None
     try:
         base = cpu_baseline()
         vs = ncf_total / base
     except Exception as e:  # never let baseline kill the bench line
         print(f"warning: cpu baseline unavailable: {e}", file=sys.stderr)
         vs = 1.0
+    # COMPACT extras only -- every key numeric or short; methodology
+    # prose lives in BENCH_NOTES.md (the driver keeps just the last
+    # 2,000 chars of output, so this line must stay short and last)
     extras = {
+        "notes_file": "BENCH_NOTES.md",
         "ncf_mfu": round(ncf_mfu, 6),
-        "ncf_note": "full Estimator.fit loop, device-cached input "
-                    "pipeline (shuffle+gather on device). NCF is "
-                    "embedding-gather-bound, so MFU is inherently tiny; "
-                    "r1 timed the raw jitted step, r2+ time the full "
-                    "fit loop (that methodology change, not a "
-                    "regression, explains the r1->r2 vs_baseline drop)",
+        "ncf_median_sps": round(ncf_median, 1),
     }
     if bert_sps is not None:
         extras.update({
@@ -395,78 +486,47 @@ def main():
             "bert_batch": bert_batch, "bert_seq_len": BERT_SEQ,
             "bert_mfu": round(bert_mfu, 4),
             "bert_median_mfu": round(bert_median_mfu, 4),
-            "bert_note": "einsum attention (A/B at b48 L384: einsum "
-                         "0.400 vs Pallas flash 0.237 -- XLA's fused "
-                         "batched-matmul attention wins at this "
-                         "shape); BERT-base SQuAD span task, bf16 "
-                         "compute, batch swept (48 beats 32/40/56/64) "
-                         "full fit loop; best of "
-                         f"{bert_windows} interleaved windows in one "
-                         "process (chip speed swings ~±25%/hour; the "
-                         "best window is the variance-proof "
-                         "comparator, median kept alongside)",
+            "bert_windows": bert_windows,
         })
     if resnet_ips is not None:
         extras.update({
-            "resnet50_imgs_per_sec_per_chip": round(resnet_ips / n_chips,
-                                                    1),
+            "resnet50_imgs_per_sec_per_chip": round(
+                resnet_ips / n_chips, 1),
             "resnet50_batch": RESNET_BATCH,
             "resnet50_mfu": round(resnet_mfu, 4),
+            "resnet50_median_mfu": round(resnet_median_mfu, 4),
             "resnet50_epoch1_s": round(resnet_epoch1, 1),
-            "resnet50_note": "synthetic ImageNet 224x224, bf16 compute, "
-                             "full fit loop (epoch 1 = cold compile; "
-                             "persistent XLA cache makes reruns warm). "
-                             "Profile evidence for the MFU ceiling "
-                             "(jax.profiler device trace, b256, r4): "
-                             "99 ms/step device time = 64 ms conv/"
-                             "elementwise fusions at ~25% MXU (1x1 "
-                             "convs are HBM-bound at bf16, early "
-                             "7x7/3x3 layers tile poorly at 224px) + "
-                             "30 ms (31%) batch-norm statistics "
-                             "convert+reduce fusions (f32 stat passes "
-                             "over ~GB-scale activations = pure HBM "
-                             "bandwidth) + 5 ms other. Swept: batch "
-                             "128/256/512 flat (2350 vs 2356 imgs/s "
-                             "at 256/512), space-to-depth stem no "
-                             "gain, bf16 BN already in use -- "
-                             "conv+bandwidth-bound under XLA on this "
-                             "chip, not input-pipeline-bound",
         })
-    if serving_rps is not None:
+    if serving is not None:
         extras.update({
-            "serving_rps": round(serving_rps, 1),
-            "serving_p50_ms": round(serving_p50, 1),
-            "serving_p99_ms": round(serving_p99, 1),
-            "serving_worker_service_p50_ms": round(serving_worker_p50,
-                                                   1),
-            "serving_payload_kb": round(serving_payload_kb, 1),
-            "serving_tunnel_mbps": round(serving_tunnel_mbps, 1),
-            "serving_note": "ResNet-18 classifier via serving launcher "
-                            f"(memory queue, batch {SERVING_BATCH}, "
-                            f"dispatch depth {SERVING_DEPTH}); best of "
-                            f"{SERVING_WINDOWS} x "
-                            f"{SERVING_SECONDS:.0f}s closed-loop "
-                            "windows. JPEG requests (~44 KB vs 147 KB "
-                            "raw) decoded server-side in a thread pool "
-                            "(PreProcessing parity). client p50 "
-                            "includes queue wait; worker_service_p50 "
-                            "is the batch's host work + un-overlapped "
-                            "device wait (the marginal per-batch cost "
-                            "under the dispatch pipeline). The "
-                            "ceiling is the axon host->device tunnel "
-                            "(serving_tunnel_mbps, swings ~5x by the "
-                            "minute): decoded uint8 is 147 KB/img to "
-                            "the device, so rps_max ~= tunnel/0.147 -- "
-                            "a tunnel artifact, not present on "
-                            "co-located TPU",
+            "serving_rps": round(serving["rps"], 1),
+            "serving_median_rps": round(serving["median_rps"], 1),
+            "serving_p50_ms": round(serving["p50_ms"], 1),
+            "serving_p99_ms": round(serving["p99_ms"], 1),
+            "serving_worker_rps": round(serving["worker_rps"], 1),
+            "serving_worker_service_p50_ms": round(
+                serving["worker_p50_ms"], 1),
+            "serving_decode_ms": round(serving["decode_ms"], 1),
+            "serving_payload_kb": round(serving["payload_kb"], 1),
+            "serving_tunnel_mbps": round(serving["tunnel_mbps"], 1),
+            "serving_windows_rejected": serving["rejected"],
+            "serving_degraded": serving["degraded"],
         })
-    print(json.dumps({
+    if scaling_eff is not None:
+        extras["scaling_efficiency_virtual8"] = round(scaling_eff, 4)
+    line = json.dumps({
         "metric": "ncf_train_samples_per_sec_per_chip",
         "value": round(ncf_per_chip, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs, 2),
         "extras": extras,
-    }))
+    })
+    if len(line) > 1500:  # keep the head-truncation guard advisory:
+        # a long line may still parse (driver keeps 2000 chars) and a
+        # late failure must never discard the whole multi-minute run
+        print(f"warning: bench line {len(line)} chars (> 1500 budget)",
+              file=sys.stderr)
+    print(line)
 
 
 if __name__ == "__main__":
