@@ -50,6 +50,12 @@ _DEFAULTS: Dict[str, Any] = {
     # layout; "auto" picks zigzag for causal when shapes divide
     "zoo.ops.ring_schedule": "auto",
     # data layer
+    # image-backbone BN statistics rows: 0 = exact full-batch stats;
+    # K > 0 computes train-time BN stats over the first K batch rows
+    # (the stat reduce is a pure HBM-bandwidth pass -- 31% of the r4
+    # ResNet-50 step; see SampledBatchNorm)
+    "zoo.models.bn_stat_rows": 0,
+
     "zoo.data.prefetch_buffer": 2,
     "zoo.data.check_batch_divisible": True,      # ref: tf_dataset.py:142-147 batch % cores == 0
     # serving

@@ -149,7 +149,11 @@ class MoEFFN(nn.Module):
             if self.expert_axis in mesh.axis_names:
                 ep_size = mesh_axis_size(mesh, self.expert_axis)
         if ep_size > 1 and e % ep_size == 0 \
-                and self.layout == "dispatch":
+                and self.layout == "dispatch" \
+                and not self.is_initializing():
+            # init traces with a 1-row example that cannot shard over
+            # the token mesh; the dense path creates the IDENTICAL
+            # parameter set, so init falls through below
             out = self._dispatch_ep(xc, wi, bi, wo, bo, top_idx, top_p,
                                     mesh, ep_size)
         elif ep_size > 1 and e % ep_size == 0:

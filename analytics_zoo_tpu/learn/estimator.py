@@ -177,6 +177,7 @@ class Estimator:
                  variables: Optional[Dict[str, Any]] = None,
                  param_spec_fn: Optional[Callable] = None,
                  aux_loss_collections: Sequence[str] = ("losses",),
+                 grad_accum_steps: int = 1,
                  seed: int = 0):
         self.adapter = (model if hasattr(model, "apply")
                         and hasattr(model, "init")
@@ -189,6 +190,14 @@ class Estimator:
         self.mesh = mesh or default_mesh()
         self.aux_loss_collections = tuple(aux_loss_collections)
         self.param_spec_fn = param_spec_fn
+        if int(grad_accum_steps) < 1:
+            raise ValueError("grad_accum_steps must be >= 1")
+        # k > 1 splits each fit batch into k microbatches inside the
+        # jitted step (lax.scan), averaging grads before ONE optimizer
+        # update: the effective batch grows k-fold at constant
+        # activation memory, and the optimizer's HBM traffic (params +
+        # moments read/write) amortizes over k microbatches
+        self.grad_accum_steps = int(grad_accum_steps)
         self.seed = seed
         self.variables = variables
         self.opt_state = None
@@ -264,7 +273,8 @@ class Estimator:
     # -------------------------------------------------------- train step --
     def _step_math(self, variables, opt_state, x, y, rng):
         """One SGD update; shared by the per-step and the device-cached
-        whole-epoch paths."""
+        whole-epoch paths. With ``grad_accum_steps`` k > 1 the batch is
+        split into k microbatches scanned inside this one update."""
         import optax
 
         adapter, loss_fn, tx = self.adapter, self.loss_fn, self.tx
@@ -272,10 +282,11 @@ class Estimator:
         params = variables.get("params", {})
         extra = {k: v for k, v in variables.items() if k != "params"}
 
-        def compute_loss(p):
+        def compute_loss(p, xb, yb, step_rng):
             preds, new_extra = adapter.apply(
-                {"params": p, **extra}, x, training=True, rng=rng)
-            loss = loss_fn(preds, y)
+                {"params": p, **extra}, xb, training=True,
+                rng=step_rng)
+            loss = loss_fn(preds, yb)
             for coll in aux_colls:
                 if coll in new_extra:
                     for leaf in jax.tree_util.tree_leaves(
@@ -287,11 +298,52 @@ class Estimator:
                          and k not in _SOW_COLLECTIONS}
             return loss, new_extra
 
-        (loss, new_extra), grads = jax.value_and_grad(
-            compute_loss, has_aux=True)(params)
+        k = self.grad_accum_steps
+        if k <= 1:
+            (loss, new_extra), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params, x, y, rng)
+        else:
+            loss, new_extra, grads = self._accum_grads(
+                compute_loss, params, x, y, rng, k)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return {"params": params, **new_extra}, opt_state, loss
+
+    @staticmethod
+    def _accum_grads(compute_loss, params, x, y, rng, k: int):
+        """Microbatch scan: mean of per-microbatch grads == the full-
+        batch gradient (losses are batch means), at 1/k the activation
+        memory and one optimizer update per k microbatches."""
+
+        def split(a):
+            if a.shape[0] % k:
+                raise ValueError(
+                    f"grad_accum_steps={k} must divide the batch "
+                    f"dim, got {a.shape[0]}")
+            return a.reshape(k, a.shape[0] // k, *a.shape[1:])
+
+        xs = jax.tree_util.tree_map(split, x)
+        ys = (jax.tree_util.tree_map(split, y)
+              if y is not None else None)
+
+        def body(carry, inp):
+            g_acc, loss_acc = carry
+            j, xj, yj = inp
+            (loss, new_extra), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(
+                params, xj, yj, jax.random.fold_in(rng, j))
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+            return (g_acc, loss_acc + loss), new_extra
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (g_sum, loss_sum), extras = jax.lax.scan(
+            body, (zeros, jnp.zeros((), jnp.float32)),
+            (jnp.arange(k), xs, ys))
+        grads = jax.tree_util.tree_map(lambda g: g / k, g_sum)
+        # mutable state (e.g. batch stats) keeps the LAST microbatch's
+        # update, the same convention a k-step loop would leave behind
+        new_extra = jax.tree_util.tree_map(lambda a: a[-1], extras)
+        return loss_sum / k, new_extra, grads
 
     def _build_train_step(self):
         if self._train_step is not None:

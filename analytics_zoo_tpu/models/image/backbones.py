@@ -26,8 +26,11 @@ import jax.numpy as jnp
 
 
 def _norm(train: bool, dtype):
-    return partial(nn.BatchNorm, use_running_average=not train,
-                   momentum=0.9, epsilon=1e-3, dtype=dtype)
+    # config-aware BN factory: exact nn.BatchNorm, or opt-in sampled
+    # statistics via zoo.models.bn_stat_rows (see SampledBatchNorm)
+    from analytics_zoo_tpu.keras.layers.normalization import batch_norm
+
+    return batch_norm(train, dtype, momentum=0.9, epsilon=1e-3)
 
 
 class InceptionBlock(nn.Module):

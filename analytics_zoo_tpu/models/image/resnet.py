@@ -8,11 +8,12 @@ as pretrained load-and-predict models, zoo/.../imageclassification).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.layers.normalization import batch_norm
 
 
 class BottleneckBlock(nn.Module):
@@ -23,8 +24,8 @@ class BottleneckBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        norm = batch_norm(train, self.dtype, momentum=0.9,
+                          epsilon=1e-5)
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False,
                     dtype=self.dtype, name="conv1")(x)
@@ -51,8 +52,8 @@ class BasicBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        norm = batch_norm(train, self.dtype, momentum=0.9,
+                          epsilon=1e-5)
         residual = x
         y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False,
                     dtype=self.dtype, name="conv1")(x)
@@ -82,9 +83,8 @@ class ResNet(nn.Module):
         x = nn.Conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3),
                     (3, 3)], use_bias=False, dtype=self.dtype,
                     name="stem_conv")(x)
-        x = nn.relu(nn.BatchNorm(use_running_average=not train,
-                                 momentum=0.9, epsilon=1e-5,
-                                 dtype=self.dtype, name="stem_bn")(x))
+        x = nn.relu(batch_norm(train, self.dtype, momentum=0.9,
+                               epsilon=1e-5)(name="stem_bn")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):

@@ -5,10 +5,11 @@ Replaces the reference's O(L^2)-materialized attention
 [B, H, L, L] score matrix through BigDL ops). On TPU the flash kernels
 never materialize scores in HBM:
 
-- head_dim % 128 == 0 -> the framework's own Pallas kernel
-  (``pallas_attention.pallas_flash_attention_fwd``, exact custom_vjp);
-- otherwise (e.g. BERT-base head_dim 64) -> the stock fused fwd+bwd
-  kernel, with key-padding masks lowered to segment ids.
+- head_dim % 64 == 0 -> the framework's own Pallas kernel
+  (``pallas_attention.pallas_flash_attention_fwd``, exact custom_vjp;
+  covers BERT-base head_dim 64 since r5);
+- otherwise -> the stock fused fwd+bwd kernel, which also serves
+  key-padding masks (lowered to segment ids).
 
 The jnp reference path handles CPU, arbitrary 4-D masks, and attention
 dropout (flash kernels don't support prob dropout -- same trade-off every
@@ -103,13 +104,13 @@ def dot_product_attention(q, k, v, mask=None, key_padding_mask=None,
                 and _platform(q) == "tpu"
                 and l % 128 == 0 and lk % 128 == 0
                 and not (causal and l > lk))
-    if flash_ok and d % 128 == 0:
+    if flash_ok and d % 64 == 0:
         from analytics_zoo_tpu.ops.pallas_attention import (
             pallas_flash_attention_fwd)
 
         if key_padding_mask is None:
             return pallas_flash_attention_fwd(q, k, v, causal, scale)
-        flash_ok = True  # fall through to stock kernel for padding masks
+        # padding masks fall through to the stock kernel's segment ids
     # the stock kernel's causal mask is top-left aligned (no cross-length
     # offset), so it only agrees with reference_attention when lq == lk
     if flash_ok and d <= 128 and (not causal or l == lk):

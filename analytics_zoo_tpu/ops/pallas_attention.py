@@ -17,10 +17,16 @@ the flash backward recurrence of Dao et al., re-derived for the TPU
 memory hierarchy. Replaces the reference's O(L^2)-materialized attention
 (ref: zoo/.../keras/layers/TransformerLayer.scala attn).
 
-Constraints: seq % block == 0, head_dim % 128 == 0 (MXU lane tiling);
-callers fall back to the jnp path otherwise. Causal masking aligns the
-diagonal bottom-right (tril k=lk-lq) to match ``reference_attention``;
-causal with len(q) > len(kv) is rejected.
+Constraints: seq % block == 0, head_dim % 64 == 0 (64 keeps the MXU at
+half lane-width on the QK/PV contractions -- the same geometry every
+d=64 attention pays, incl. XLA's einsum -- while 128-multiples ride it
+full); callers fall back to the jnp path otherwise. Causal masking
+aligns the diagonal bottom-right (tril k=lk-lq) to match
+``reference_attention``; causal with len(q) > len(kv) is rejected.
+
+The grid is declared (parallel, parallel, arbitrary) so Mosaic
+pipelines the sequential kv/q accumulation dimension while batch and
+row blocks schedule freely.
 """
 
 from __future__ import annotations
@@ -139,8 +145,8 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
     if l % block_q or lk % block_k:
         raise ValueError(f"seq lens ({l},{lk}) must divide blocks "
                          f"({block_q},{block_k})")
-    if d % 128:
-        raise ValueError(f"head_dim {d} must be a multiple of 128")
+    if d % 64:
+        raise ValueError(f"head_dim {d} must be a multiple of 64")
     if causal and l > lk:
         # rows attending to nothing are undefined under flash semantics
         raise ValueError("causal attention requires len(q) <= len(kv)")
@@ -173,6 +179,7 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        compiler_params=_grid_semantics(),
         interpret=_interpret(),
     )(qr, kr, vr)
     out = res[0]
@@ -183,6 +190,18 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
 def _interpret() -> bool:
     # interpret mode runs the kernel logic on CPU (tests); compiled on TPU
     return jax.default_backend() != "tpu"
+
+
+def _grid_semantics():
+    """All three kernels iterate their LAST grid dim sequentially (the
+    online-softmax / gradient accumulation over kv- or q-blocks) while
+    the leading (batch*heads, row-block) dims are independent; telling
+    Mosaic so lets it overlap the next block's HBM->VMEM copies with
+    the current block's compute instead of assuming a serial grid."""
+    if _interpret():
+        return None  # interpret mode takes no TPU compiler params
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -301,6 +320,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
                                lambda bh_, a, b_: (bh_, a, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, l, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_grid_semantics(),
         interpret=_interpret(),
     )(qr, kr, vr, dor, lse, delta)
 
@@ -325,6 +345,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_grid_semantics(),
         interpret=_interpret(),
     )(qr, kr, vr, dor, lse, delta)
     return (dq.reshape(b, h, l, d), dk.reshape(b, h, lk, d),

@@ -26,3 +26,6 @@ from analytics_zoo_tpu.serving.timer import Timer  # noqa: F401
 from analytics_zoo_tpu.serving.http_frontend import (  # noqa: F401
     HttpFrontend,
 )
+from analytics_zoo_tpu.serving.redis_adapter import (  # noqa: F401
+    RedisFrontend,
+)

@@ -66,12 +66,14 @@ class ServingApp:
 
     def __init__(self, model: InferenceModel, worker: ServingWorker,
                  input_queue: InputQueue, output_queue: OutputQueue,
-                 frontend: Optional[HttpFrontend]):
+                 frontend: Optional[HttpFrontend],
+                 redis_frontend=None):
         self.model = model
         self.worker = worker
         self.input_queue = input_queue
         self.output_queue = output_queue
         self.frontend = frontend
+        self.redis_frontend = redis_frontend
 
     @property
     def address(self) -> Optional[str]:
@@ -80,6 +82,8 @@ class ServingApp:
     def stop(self) -> None:
         if self.frontend is not None:
             self.frontend.stop()
+        if self.redis_frontend is not None:
+            self.redis_frontend.stop()
         self.worker.stop()
         logger.info("serving stopped")
 
@@ -162,6 +166,7 @@ def launch(config: Dict[str, Any]) -> ServingApp:
         top_n=params.get("top_n"),
         pipeline_depth=params.get("pipeline_depth", 2)).start()
     frontend = None
+    redis_fe = None
     try:
         if http.get("enabled", True):
             frontend = HttpFrontend(
@@ -170,10 +175,36 @@ def launch(config: Dict[str, Any]) -> ServingApp:
                 certfile=http.get("certfile"),
                 keyfile=http.get("keyfile")).start()
             logger.info("serving ready at %s", frontend.address)
+        redis_cfg = config.get("redis") or {}
+        if redis_cfg.get("enabled"):
+            # reference-client interop: a RESP2 adapter speaking the
+            # cluster-serving Redis-stream + Arrow wire format
+            # (redis_adapter.py). The adapter DRAINS the output queue,
+            # exactly like the HTTP frontend's result router -- two
+            # drainers on one queue would steal each other's results
+            # nondeterministically, so the combination is rejected
+            # here rather than discovered as hung clients
+            if frontend is not None:
+                raise ValueError(
+                    "redis.enabled requires http.enabled: false -- "
+                    "both frontends drain the same result queue (use "
+                    "two deployments on a shared tcp broker to serve "
+                    "both protocols)")
+            from analytics_zoo_tpu.serving.redis_adapter import (
+                RedisFrontend)
+
+            redis_fe = RedisFrontend(
+                in_q, out_q, host=redis_cfg.get("host", "127.0.0.1"),
+                port=int(redis_cfg.get("port", 6379)),
+                name=redis_cfg.get("stream", "serving_stream")).serve()
     except Exception:
-        worker.stop()  # no ServingApp handle escapes; don't leak it
+        # no ServingApp handle escapes; don't leak running pieces
+        if frontend is not None:
+            frontend.stop()
+        worker.stop()
         raise
-    return ServingApp(model, worker, in_q, out_q, frontend)
+    return ServingApp(model, worker, in_q, out_q, frontend,
+                      redis_frontend=redis_fe)
 
 
 def launch_from_yaml(path: str) -> ServingApp:

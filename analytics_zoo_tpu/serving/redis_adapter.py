@@ -1,0 +1,333 @@
+"""Redis-protocol serving adapter: reference clients work unchanged.
+
+The reference's cluster-serving clients speak Redis streams with
+Arrow-encoded tensors (ref: pyzoo/zoo/serving/client.py:37-221 --
+``XADD serving_stream uri=<id> data=<b64 Arrow RecordBatch>``, results
+read back as hashes ``cluster-serving_<stream>:<uri>`` via
+KEYS/HGETALL/DEL; ref wire schema: pyzoo/zoo/serving/schema.py
+get_field_and_data). This repo's data plane is its own queue design
+(queues.py), so this module bridges the gap: a minimal RESP2 server
+that accepts exactly the command surface those clients use and adapts
+it onto any InputQueue/OutputQueue backend pair.
+
+Served commands: XGROUP CREATE, XADD, INFO, KEYS, HGETALL, DEL, PING,
+CLIENT * (redis-py connection handshake), EXISTS. Everything else gets
+a clear -ERR.
+
+Wire-format notes:
+- XADD ``data`` fields hold a base64 Arrow RecordBatch stream; dense
+  tensors arrive as the reference's 4-row struct (indiceData /
+  indiceShape / data / shape), strings as base64 image bytes. Sparse
+  tensors are rejected with a clear error (this serving stack has no
+  sparse input path).
+- Results are stored as ``cluster-serving_<stream>:<uri>`` hashes with
+  a ``value`` field holding the JSON-encoded output tensor(s) --
+  nested lists, the shape the reference's HTTP route exposes.
+"""
+
+from __future__ import annotations
+
+import base64
+import fnmatch
+import io
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+RESULT_PREFIX = "cluster-serving_"
+
+
+# ------------------------------------------------------------- arrow --
+def decode_arrow_payload(b64: bytes) -> Dict[str, np.ndarray]:
+    """Base64 Arrow RecordBatch stream -> named input tensors, per the
+    reference's schema (ref: schema.py get_field_and_data)."""
+    import pyarrow as pa
+
+    buf = base64.b64decode(b64)
+    reader = pa.ipc.open_stream(buf)
+    batch = next(iter(reader))
+    out: Dict[str, np.ndarray] = {}
+    for name, col in zip(batch.schema.names, batch.columns):
+        rows = col.to_pylist()
+        if isinstance(rows[0], dict):  # tensor struct (dense or sparse)
+            merged: Dict[str, Any] = {}
+            for row in rows:
+                for k, v in (row or {}).items():
+                    if v:
+                        merged[k] = v
+            if merged.get("indiceData"):
+                raise ValueError(
+                    f"input {name!r} is a sparse tensor; this serving "
+                    "stack accepts dense tensors and images only")
+            data = np.asarray(merged.get("data", []), np.float32)
+            shape = [int(s) for s in merged.get("shape", [])]
+            out[name] = data.reshape(shape) if shape else data
+        else:  # string: base64 image bytes (the reference's image path)
+            raw = base64.b64decode(rows[0])
+            out[name] = np.frombuffer(raw, np.uint8)
+    return out
+
+
+def encode_result_value(tensors: Dict[str, np.ndarray]) -> str:
+    """Output tensors -> the JSON string stored under the result
+    hash's ``value`` field."""
+    def tolist(a):
+        a = np.asarray(a)
+        return a.item() if a.ndim == 0 else a.tolist()
+
+    clean = {k: tolist(v) for k, v in tensors.items()}
+    if list(clean) == ["output"]:
+        return json.dumps(clean["output"])
+    return json.dumps(clean)
+
+
+# -------------------------------------------------------------- resp --
+class _RespConnection:
+    """Parses RESP2 command arrays off one client socket."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = b""
+
+    def _fill(self) -> bool:
+        chunk = self.sock.recv(65536)
+        if not chunk:
+            return False
+        self.buf += chunk
+        return True
+
+    def _line(self) -> Optional[bytes]:
+        while b"\r\n" not in self.buf:
+            if not self._fill():
+                return None
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _nbytes(self, n: int) -> Optional[bytes]:
+        while len(self.buf) < n + 2:
+            if not self._fill():
+                return None
+        data, self.buf = self.buf[:n], self.buf[n + 2:]
+        return data
+
+    def read_command(self) -> Optional[List[bytes]]:
+        line = self._line()
+        if line is None:
+            return None
+        if not line.startswith(b"*"):  # inline command (telnet style)
+            return line.split() or self.read_command()
+        n = int(line[1:])
+        parts = []
+        for _ in range(n):
+            hdr = self._line()
+            if hdr is None or not hdr.startswith(b"$"):
+                return None
+            data = self._nbytes(int(hdr[1:]))
+            if data is None:
+                return None
+            parts.append(data)
+        return parts
+
+    # replies ----------------------------------------------------------
+    def ok(self, msg: str = "OK") -> None:
+        self.sock.sendall(f"+{msg}\r\n".encode())
+
+    def error(self, msg: str) -> None:
+        self.sock.sendall(f"-ERR {msg}\r\n".encode())
+
+    def integer(self, n: int) -> None:
+        self.sock.sendall(f":{n}\r\n".encode())
+
+    def bulk(self, data) -> None:
+        if data is None:
+            self.sock.sendall(b"$-1\r\n")
+            return
+        if isinstance(data, str):
+            data = data.encode()
+        self.sock.sendall(b"$%d\r\n%s\r\n" % (len(data), data))
+
+    def array(self, items) -> None:
+        self.sock.sendall(b"*%d\r\n" % len(items))
+        for it in items:
+            self.bulk(it)
+
+
+class RedisFrontend:
+    """RESP2 server bridging reference serving clients onto this
+    stack's queue backends. Start with ``serve()``; stop with
+    ``stop()``. A drain thread moves worker results from the output
+    queue into the KEYS/HGETALL-visible result table."""
+
+    def __init__(self, input_queue, output_queue,
+                 host: str = "127.0.0.1", port: int = 6379,
+                 name: str = "serving_stream"):
+        self._in = input_queue
+        self._out = output_queue
+        self.name = name
+        self._results: Dict[str, Dict[str, str]] = {}
+        self._groups: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._seq = 0
+
+        adapter = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                conn = _RespConnection(self.request)
+                while not adapter._stop.is_set():
+                    try:
+                        cmd = conn.read_command()
+                    except (ConnectionError, OSError):
+                        return
+                    if cmd is None:
+                        return
+                    try:
+                        adapter._dispatch(conn, cmd)
+                    except (ConnectionError, OSError):
+                        return
+                    except Exception as e:  # one bad command, not the
+                        logger.exception(   # whole connection
+                            "redis adapter command failed: %s", e)
+                        try:
+                            conn.error(str(e))
+                        except OSError:
+                            return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._threads: List[threading.Thread] = []
+
+    # ---------------------------------------------------------- life --
+    def serve(self) -> "RedisFrontend":
+        t = threading.Thread(target=self._server.serve_forever,
+                             daemon=True)
+        d = threading.Thread(target=self._drain_loop, daemon=True)
+        t.start()
+        d.start()
+        self._threads = [t, d]
+        logger.info("redis adapter listening on %s:%d (stream %s)",
+                    self.host, self.port, self.name)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def _drain_loop(self) -> None:
+        while not self._stop.is_set():
+            moved = 0
+            for uri, tensors in self._out.dequeue_all():
+                key = f"{RESULT_PREFIX}{self.name}:{uri}"
+                with self._lock:
+                    self._results[key] = {
+                        "value": encode_result_value(tensors)}
+                moved += 1
+            if not moved:
+                time.sleep(0.005)
+
+    # ------------------------------------------------------ commands --
+    def _dispatch(self, conn: _RespConnection,
+                  cmd: List[bytes]) -> None:
+        op = cmd[0].decode().upper()
+        if op == "PING":
+            conn.ok("PONG")
+        elif op in ("CLIENT", "HELLO", "SELECT"):
+            conn.ok()  # redis-py connection handshake chatter
+        elif op == "XGROUP":
+            self._xgroup(conn, cmd)
+        elif op == "XADD":
+            self._xadd(conn, cmd)
+        elif op == "INFO":
+            # the reference client's back-pressure check reads
+            # used_memory vs maxmemory; report a tiny fraction so it
+            # always proceeds (our queues do their own bounding)
+            conn.bulk("# Memory\r\nused_memory:1\r\n"
+                      "maxmemory:1000000000\r\n")
+        elif op == "KEYS":
+            pat = cmd[1].decode()
+            with self._lock:
+                keys = [k for k in self._results
+                        if fnmatch.fnmatchcase(k, pat)]
+            conn.array(keys)
+        elif op == "HGETALL":
+            key = cmd[1].decode()
+            with self._lock:
+                entry = self._results.get(key, {})
+                flat: List[str] = []
+                for k, v in entry.items():
+                    flat.extend([k, v])
+            conn.array(flat)
+        elif op in ("DEL", "UNLINK"):
+            n = 0
+            with self._lock:
+                for raw in cmd[1:]:
+                    n += self._results.pop(raw.decode(), None) is not None
+            conn.integer(n)
+        elif op == "EXISTS":
+            with self._lock:
+                n = sum(raw.decode() in self._results
+                        for raw in cmd[1:])
+            conn.integer(n)
+        else:
+            conn.error(f"unknown command '{op}' (this is the "
+                       "analytics-zoo-tpu serving adapter, not a full "
+                       "redis server)")
+
+    def _xgroup(self, conn: _RespConnection, cmd: List[bytes]) -> None:
+        sub = cmd[1].decode().upper() if len(cmd) > 1 else ""
+        if sub != "CREATE" or len(cmd) < 4:
+            conn.error("only XGROUP CREATE is supported")
+            return
+        key = (cmd[2].decode(), cmd[3].decode())
+        if key in self._groups:
+            # match real redis so client retry logic behaves
+            self.sock_err(conn, "BUSYGROUP Consumer Group name "
+                                "already exists")
+            return
+        self._groups.add(key)
+        conn.ok()
+
+    @staticmethod
+    def sock_err(conn: _RespConnection, msg: str) -> None:
+        conn.sock.sendall(f"-{msg}\r\n".encode())
+
+    def _xadd(self, conn: _RespConnection, cmd: List[bytes]) -> None:
+        if len(cmd) < 5:
+            conn.error("XADD needs stream, id and field/value pairs")
+            return
+        fields: Dict[bytes, bytes] = {}
+        for i in range(3, len(cmd) - 1, 2):
+            fields[cmd[i]] = cmd[i + 1]
+        # sequence allocation stays inside the lock: concurrent
+        # uri-less XADDs must never share a generated uri (results are
+        # keyed by uri -- a collision overwrites someone's prediction)
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        uri = fields.get(b"uri", b"").decode() or f"req-{seq}"
+        payload = fields.get(b"data")
+        if payload is None:
+            conn.error("XADD entry carries no 'data' field")
+            return
+        tensors = decode_arrow_payload(payload)
+        if not self._in.enqueue(uri, **tensors):
+            conn.error("OOM input queue full")  # redis-speak for full
+            return
+        conn.bulk(f"{int(time.time() * 1000)}-{seq}")

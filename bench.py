@@ -47,10 +47,11 @@ NCF_EPOCHS = 5  # first epoch absorbs compile; later epochs measured
 
 # BERT-base SQuAD fine-tune config (ref: bert_squad.py / BERT-base).
 # batch swept on v5e: 48 beats 32/40/56/64 (0.39-0.40 vs 0.36-0.38
-# MFU). Attention kernel A/B at b48 L384: einsum 0.400 vs Pallas
-# flash 0.237 (flash engaged via attention_flash_min_seq=256) -- the
-# library's einsum-below-512 default is right here, so the bench
-# leaves it alone
+# MFU). Attention kernel crossover (r5, docs/kernels.md): owned
+# Pallas flash ties einsum at L384 and wins >=1024, so the library's
+# einsum-below-512 dispatch default is measured, not assumed -- the
+# bench leaves it alone. Grad accumulation / device_cache / remat all
+# measured unhelpful at this shape (BENCH_NOTES.md negative results)
 BERT_VOCAB, BERT_SEQ = 30522, 384
 BERT_BATCH = 48
 BERT_STEPS = 16
@@ -344,18 +345,29 @@ def measure_serving(seconds: float, batch: int):
             # the wire is PCIe/ICI rather than this rig's tunnel.
             # predict_async canonicalizes through np.asarray (a host
             # pull), so the compiled apply is timed directly
+            import jax.numpy as jnp
+
             model = app.worker.model
             imgs = np.repeat(arr[None], batch, axis=0)
             x_dev = jax.device_put(imgs)
             fn = jax.jit(model._apply_fn)
-            jax.block_until_ready(fn(model.variables, x_dev))
+
+            def fence(out):
+                # block_until_ready does NOT wait on the axon remote
+                # runtime; only a device->host VALUE pull fences the
+                # serial device queue, so each timing window ends with
+                # a scalar fetch (one f32 -- negligible wire cost)
+                leaf = jax.tree_util.tree_leaves(out)[0]
+                float(jnp.sum(leaf.astype(jnp.float32)))
+
+            fence(fn(model.variables, x_dev))
             rates = []
             for _ in range(3):
                 iters = 20
                 t0 = time.perf_counter()
                 for _i in range(iters):
                     out = fn(model.variables, x_dev)
-                jax.block_until_ready(out)
+                fence(out)
                 rates.append(batch * iters /
                              (time.perf_counter() - t0))
             worker_rps = max(rates)

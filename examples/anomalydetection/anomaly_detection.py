@@ -57,6 +57,12 @@ def main():
     hits = len(flagged & true_anomalies)
     print(f"flagged {len(flagged)} points, "
           f"recovered {hits}/{len(true_anomalies)} injected anomalies")
+    # quality bar: +-3-sigma spikes on a smooth sine must be caught
+    # with high recall AND without flooding the detector (precision)
+    assert hits >= 0.7 * len(true_anomalies), (
+        f"anomaly recall collapsed: {hits}/{len(true_anomalies)}")
+    assert len(flagged) <= 3 * len(true_anomalies), (
+        f"anomaly precision collapsed: {len(flagged)} flagged")
 
 
 if __name__ == "__main__":

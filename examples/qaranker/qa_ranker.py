@@ -71,7 +71,12 @@ def main():
     for x, y in lists:
         scores = np.asarray(model.predict(x, batch_size=8)).ravel()
         wins += int(scores[np.argmax(y)] > scores[np.argmin(y)])
-    print(f"pairwise ranking accuracy: {wins / len(lists):.3f}")
+    acc = wins / len(lists)
+    print(f"pairwise ranking accuracy: {acc:.3f}")
+    # quality bar: on-topic answers share tokens with their question,
+    # so a trained KNRM must rank positives over negatives (this is
+    # NDCG@1 on one-positive/one-negative lists)
+    assert acc >= 0.75, f"qa ranking stopped learning: {acc:.3f}"
 
 
 if __name__ == "__main__":

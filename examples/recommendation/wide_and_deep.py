@@ -24,7 +24,14 @@ def synthetic_tabular(n, seed=0):
     cont = rng.randn(n, 3).astype(np.float32)
     y = ((wide[:, 0] > 10).astype(int) + (cont[:, 0] > 0) + 1
          ).astype(np.int32)  # ratings 1..3
-    return {"wide": wide, "embed": embed, "continuous": cont}, y
+    # the wide tensor holds indices into ONE concatenated one-hot
+    # space, so each column's ids are shifted by the widths of the
+    # columns before it (the reference assembles wide features the
+    # same way, ref: WideAndDeep feature engineering getWideTensor);
+    # without the offset, columns alias each other's table rows
+    wide_offset = wide + np.asarray([0, 20], np.int32)[None, :]
+    return ({"wide": wide_offset, "embed": embed,
+             "continuous": cont}, y)
 
 
 def main():
@@ -34,10 +41,15 @@ def main():
                     choices=["wide_n_deep", "wide", "deep"])
     args = ap.parse_args()
     n = 10_000 if args.quick else 100_000
-    epochs = 3 if args.quick else 10
+    # post-compile epochs cost ~40 ms each at this scale; the model
+    # needs ~12 to crack the label rule, so quick mode can afford them
+    epochs = 15 if args.quick else 20
 
+    # wide columns take values 1..19, so their one-hot/cross buckets
+    # need 20 slots -- undersized dims would alias ids above 9 and
+    # erase the (wide > 10) half of the label signal
     info = ColumnFeatureInfo(
-        wide_base_cols=["a", "b"], wide_base_dims=[10, 10],
+        wide_base_cols=["a", "b"], wide_base_dims=[20, 20],
         embed_cols=["c", "d"], embed_in_dims=[10, 10],
         embed_out_dims=[8, 8], continuous_cols=["x", "y", "z"])
     x, y = synthetic_tabular(n)
@@ -49,6 +61,12 @@ def main():
     res = model.evaluate(({k: v[cut:] for k, v in x.items()}, y[cut:]),
                          batch_size=512)
     print("validation:", res)
+    # quality bar: the label is a deterministic function of one wide
+    # and one continuous column; a joint wide+deep model must crack it
+    bar = 0.80 if args.model_type == "wide_n_deep" else 0.55
+    assert res["accuracy"] >= bar, (
+        f"wide&deep stopped learning: accuracy {res['accuracy']:.3f} "
+        f"< {bar}")
 
 
 if __name__ == "__main__":

@@ -58,7 +58,12 @@ def main():
     xt, yt = train.to_arrays()
     xv, yv = val.to_arrays()
     model.fit((xt, yt), batch_size=32, epochs=epochs)
-    print("validation:", model.evaluate((xv, yv), batch_size=32))
+    res = model.evaluate((xv, yv), batch_size=32)
+    print("validation:", res)
+    # quality bar: the two sentiment banks share no tokens, so a
+    # working encoder must separate them almost perfectly
+    assert res["accuracy"] >= 0.9, (
+        f"text classifier stopped learning: {res['accuracy']:.3f}")
 
 
 if __name__ == "__main__":

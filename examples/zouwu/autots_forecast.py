@@ -44,6 +44,10 @@ def main():
                            recipe=SmokeRecipe(), metric="mse")
     res = pipeline.evaluate(df.iloc[cut:], metrics=["mse", "smape"])
     print("holdout:", res)
+    # quality bar: a clean daily sine with small noise must forecast
+    # within 25 sMAPE even from the smoke search space
+    assert res["smape"] <= 25.0, (
+        f"autots forecast degraded: smape {res['smape']:.1f}")
     preds = pipeline.predict(df.iloc[cut:])
     print("forecast head:", preds["value"].head().round(3).tolist())
 

@@ -87,6 +87,62 @@ class TestEstimatorFit:
         assert "val_accuracy" in hist[-1]
 
 
+class TestGradAccumulation:
+    """grad_accum_steps k splits each batch into k microbatches inside
+    one jitted update; mean-of-microbatch-grads == full-batch grad, so
+    the parameter trajectory must match the k=1 run exactly."""
+
+    def _fit(self, accum, device_cache=False, seed=3):
+        x, y = make_blobs(seed=seed)
+        est = Estimator(TinyMLP(),
+                        loss="sparse_categorical_crossentropy",
+                        optimizer=SGD(0.05), seed=0,
+                        grad_accum_steps=accum)
+        hist = est.fit((x, y), batch_size=64, epochs=2,
+                       device_cache=device_cache)
+        return est, hist
+
+    def test_matches_no_accum_exactly(self):
+        est1, h1 = self._fit(1)
+        est4, h4 = self._fit(4)
+        flat1 = jax.tree_util.tree_leaves(est1.variables["params"])
+        flat4 = jax.tree_util.tree_leaves(est4.variables["params"])
+        for a, b in zip(flat1, flat4):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+        assert h4[-1]["loss"] == pytest.approx(h1[-1]["loss"],
+                                               rel=1e-3)
+
+    def test_device_cached_epoch_path(self):
+        est1, h1 = self._fit(1, device_cache=True)
+        est2, h2 = self._fit(2, device_cache=True)
+        flat1 = jax.tree_util.tree_leaves(est1.variables["params"])
+        flat2 = jax.tree_util.tree_leaves(est2.variables["params"])
+        for a, b in zip(flat1, flat2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_dropout_model_still_trains(self):
+        x, y = make_blobs()
+        est = Estimator(DropoutNet(),
+                        loss="sparse_categorical_crossentropy",
+                        optimizer=Adam(1e-2), grad_accum_steps=2)
+        hist = est.fit((x, y), batch_size=64, epochs=3)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_indivisible_batch_raises(self):
+        x, y = make_blobs(96)
+        est = Estimator(TinyMLP(),
+                        loss="sparse_categorical_crossentropy",
+                        grad_accum_steps=5)
+        with pytest.raises(ValueError, match="grad_accum"):
+            est.fit((x, y), batch_size=32, epochs=1)
+
+    def test_bad_value_rejected(self):
+        with pytest.raises(ValueError, match="grad_accum"):
+            Estimator(TinyMLP(), loss="mse", grad_accum_steps=0)
+
+
 class TestCheckpoint:
     def test_save_restore_roundtrip(self, tmp_path):
         x, y = make_blobs()

@@ -110,12 +110,13 @@ class TestPallasKernel:
     so its online-softmax logic is exercised by the normal test suite."""
 
     @pytest.mark.parametrize("causal", [False, True])
-    def test_kernel_matches_reference(self, causal):
+    @pytest.mark.parametrize("d", [64, 128])  # 64 = BERT-base heads
+    def test_kernel_matches_reference(self, causal, d):
         from analytics_zoo_tpu.ops import (
             pallas_flash_attention_fwd, reference_attention)
 
         rng = np.random.RandomState(0)
-        b, h, l, d = 1, 2, 256, 128
+        b, h, l = 1, 2, 256
         q = jnp.asarray(rng.randn(b, h, l, d), jnp.float32)
         k = jnp.asarray(rng.randn(b, h, l, d), jnp.float32)
         v = jnp.asarray(rng.randn(b, h, l, d), jnp.float32)
@@ -159,14 +160,15 @@ class TestPallasKernel:
         assert bool(jnp.isfinite(g).all())
 
     @pytest.mark.parametrize("causal", [False, True])
-    def test_flash_backward_matches_reference_grads(self, causal):
+    @pytest.mark.parametrize("d", [64, 128])  # 64 = BERT-base heads
+    def test_flash_backward_matches_reference_grads(self, causal, d):
         # the blockwise dq/dk/dv kernels must match grads through the
         # dense jnp path (golden numerics for the flash backward)
         from analytics_zoo_tpu.ops import (
             pallas_flash_attention_fwd, reference_attention)
 
         rng = np.random.RandomState(3)
-        b, h, l, d = 2, 2, 256, 128
+        b, h, l = 2, 2, 256
         q = jnp.asarray(rng.randn(b, h, l, d), jnp.float32)
         k = jnp.asarray(rng.randn(b, h, l, d), jnp.float32)
         v = jnp.asarray(rng.randn(b, h, l, d), jnp.float32)
