@@ -196,7 +196,11 @@ class Estimator:
         # jitted step (lax.scan), averaging grads before ONE optimizer
         # update: the effective batch grows k-fold at constant
         # activation memory, and the optimizer's HBM traffic (params +
-        # moments read/write) amortizes over k microbatches
+        # moments read/write) amortizes over k microbatches.
+        # Exact-parity caveat: batch-COUPLED layers (BatchNorm and
+        # friends) see B/k rows per microbatch, so their statistics --
+        # and hence the trajectory -- differ from the k=1 run; the
+        # exact-parity guarantee holds for per-sample models only
         self.grad_accum_steps = int(grad_accum_steps)
         self.seed = seed
         self.variables = variables
@@ -313,7 +317,10 @@ class Estimator:
     def _accum_grads(compute_loss, params, x, y, rng, k: int):
         """Microbatch scan: mean of per-microbatch grads == the full-
         batch gradient (losses are batch means), at 1/k the activation
-        memory and one optimizer update per k microbatches."""
+        memory and one optimizer update per k microbatches. Holds
+        exactly for per-sample models; batch-coupled layers (e.g.
+        BatchNorm) compute statistics over B/k rows instead of B, so
+        their trajectory legitimately differs from the k=1 run."""
 
         def split(a):
             if a.shape[0] % k:

@@ -183,10 +183,16 @@ class RedisFrontend:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # finite recv timeout: idle connections must re-check
+                # the stop flag, or every stop() would leak a thread
+                # blocked in recv until its client happens to speak
+                self.request.settimeout(0.5)
                 conn = _RespConnection(self.request)
                 while not adapter._stop.is_set():
                     try:
                         cmd = conn.read_command()
+                    except socket.timeout:
+                        continue  # idle; re-check stop flag
                     except (ConnectionError, OSError):
                         return
                     if cmd is None:
@@ -311,6 +317,15 @@ class RedisFrontend:
     def _xadd(self, conn: _RespConnection, cmd: List[bytes]) -> None:
         if len(cmd) < 5:
             conn.error("XADD needs stream, id and field/value pairs")
+            return
+        stream = cmd[1].decode()
+        if stream != self.name:
+            # results are keyed under the CONFIGURED stream; silently
+            # accepting another name would strand the client polling
+            # result keys that never appear -- fail fast instead
+            conn.error(f"this adapter serves stream {self.name!r}, "
+                       f"not {stream!r} (set the client's name= to "
+                       "match the deployment's redis.stream)")
             return
         fields: Dict[bytes, bytes] = {}
         for i in range(3, len(cmd) - 1, 2):

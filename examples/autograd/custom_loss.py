@@ -1,0 +1,76 @@
+"""Training with an autograd-built custom loss.
+
+The analog of the reference's autograd examples
+(ref: pyzoo/zoo/examples/autograd/custom.py + customloss.py — losses
+assembled from Variable math and compiled into the optimizer): here
+the same ``A.*`` ops build an asymmetric regression loss (under-
+predictions cost 4x more than over-predictions, the classic inventory
+objective), and the fitted model's bias demonstrates the loss took
+effect — it over-predicts relative to an MSE fit.
+
+Run: python examples/autograd/custom_loss.py [--quick]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import autograd as A
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras.layers import Dense
+
+
+def make_data(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x @ np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+         + 0.1 * rng.randn(n)).astype(np.float32)[:, None]
+    return x, y
+
+
+def asymmetric_loss(y_pred, y_true):
+    """Under-prediction (y_pred < y_true) costs 4x over-prediction."""
+    diff = y_pred - y_true
+    return A.mean(A.maximum(-4.0 * diff, diff), axis=0)
+
+
+def fit(loss, x, y, epochs):
+    model = Sequential([Dense(16, activation="relu"), Dense(1)])
+    model.compile(optimizer="adam", loss=loss)
+    model.fit(x, y, batch_size=64, nb_epoch=epochs)
+    return np.asarray(model.predict(x, batch_size=256))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    n = 2000 if args.quick else 20000
+    epochs = 15 if args.quick else 40
+
+    x, y = make_data(n)
+    preds_asym = fit(A.CustomLoss(asymmetric_loss), x, y, epochs)
+    preds_mse = fit("mse", x, y, epochs)
+
+    bias_asym = float(np.mean(preds_asym - y))
+    bias_mse = float(np.mean(preds_mse - y))
+    mae = float(np.mean(np.abs(preds_asym - y)))
+    print(f"mean bias: asymmetric {bias_asym:+.3f} vs mse "
+          f"{bias_mse:+.3f}; asymmetric MAE {mae:.3f}")
+    # quality bars: the custom loss must (a) actually fit the signal
+    # and (b) shift predictions upward relative to the symmetric fit
+    # (that shift IS the custom objective working)
+    assert mae < 0.5, f"custom-loss fit failed: MAE {mae:.3f}"
+    assert bias_asym > bias_mse + 0.05, (
+        f"asymmetric loss did not bias predictions: "
+        f"{bias_asym:+.3f} vs {bias_mse:+.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Fraud detection on imbalanced tabular data through NNFrames.
+
+The analog of the reference's fraud-detection app
+(ref: apps/fraud-detection/fraud-detection.ipynb — an imbalanced
+binary classifier trained through the DataFrame pipeline): ~2% fraud
+rate, DataFrame in, scored DataFrame out, evaluated by ROC-AUC (the
+only honest metric at this imbalance).
+
+Run: python examples/fraud/fraud_detection.py [--quick]
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras.layers import Dense, Dropout
+from analytics_zoo_tpu.nnframes import NNEstimator, SeqToTensor
+
+FEATURES = 8
+FRAUD_RATE = 0.02
+
+
+def transactions(n, seed=0):
+    """Synthetic card transactions: fraud concentrates at high amounts
+    in odd hours with a shifted latent profile."""
+    rng = np.random.RandomState(seed)
+    fraud = rng.rand(n) < FRAUD_RATE
+    x = rng.randn(n, FEATURES).astype(np.float32)
+    x[fraud] += np.linspace(0.5, 2.0, FEATURES)[None, :]
+    df = pd.DataFrame({"features": [r for r in x],
+                       "label": fraud.astype(np.float32)})
+    return df
+
+
+def roc_auc(scores, labels):
+    """Rank-based AUC (Mann-Whitney), no sklearn dependency."""
+    order = np.argsort(scores)
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels > 0.5
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (
+        n_pos * n_neg)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    n = 4000 if args.quick else 40000
+    # at a 2% positive rate the gradient signal is thin: the model
+    # needs the full schedule before the ranking flips decisively
+    epochs = 20 if args.quick else 40
+
+    df = transactions(n)
+    cut = int(0.8 * n)
+    train, test = df.iloc[:cut], df.iloc[cut:]
+
+    model = Sequential([Dense(32, activation="relu"),
+                        Dropout(0.2),
+                        Dense(16, activation="relu"),
+                        Dense(1, activation="sigmoid")])
+    est = (NNEstimator(model, criterion="binary_crossentropy",
+                       feature_preprocessing=SeqToTensor([FEATURES]))
+           .setBatchSize(256).setMaxEpoch(epochs)
+           .setLearningRate(1e-2))
+    fitted = est.fit(train)
+    scored = fitted.transform(test)
+    scores = np.asarray([np.ravel(p)[0]
+                         for p in scored["prediction"]])
+    auc = roc_auc(scores, test["label"].values)
+    rate = test["label"].mean()
+    print(f"test fraud rate {rate:.3f}, ROC-AUC {auc:.3f}")
+    # quality bar: the shifted fraud profile is separable; anything
+    # under 0.9 AUC means the pipeline stopped learning (accuracy
+    # would read 98% by predicting 'legit' -- AUC cannot be gamed)
+    assert auc >= 0.9, f"fraud detector stopped learning: AUC {auc:.3f}"
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,9 @@ import numpy as np
 from analytics_zoo_tpu.models import ColumnFeatureInfo, WideAndDeep
 
 
+WIDE_DIMS = [20, 20]  # one-hot width per wide column (values 1..19)
+
+
 def synthetic_tabular(n, seed=0):
     rng = np.random.RandomState(seed)
     wide = rng.randint(1, 20, (n, 2)).astype(np.int32)
@@ -29,8 +32,8 @@ def synthetic_tabular(n, seed=0):
     # columns before it (the reference assembles wide features the
     # same way, ref: WideAndDeep feature engineering getWideTensor);
     # without the offset, columns alias each other's table rows
-    wide_offset = wide + np.asarray([0, 20], np.int32)[None, :]
-    return ({"wide": wide_offset, "embed": embed,
+    offsets = np.cumsum([0] + WIDE_DIMS[:-1]).astype(np.int32)
+    return ({"wide": wide + offsets[None, :], "embed": embed,
              "continuous": cont}, y)
 
 
@@ -49,7 +52,7 @@ def main():
     # need 20 slots -- undersized dims would alias ids above 9 and
     # erase the (wide > 10) half of the label signal
     info = ColumnFeatureInfo(
-        wide_base_cols=["a", "b"], wide_base_dims=[20, 20],
+        wide_base_cols=["a", "b"], wide_base_dims=WIDE_DIMS,
         embed_cols=["c", "d"], embed_in_dims=[10, 10],
         embed_out_dims=[8, 8], continuous_cols=["x", "y", "z"])
     x, y = synthetic_tabular(n)

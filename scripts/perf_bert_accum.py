@@ -70,6 +70,9 @@ def main():
             print(f"  skipping {name}")
             cfgs = [c for c in cfgs if c[0] != name]
 
+    if "b48" not in models:
+        print("baseline b48 never built; aborting", file=sys.stderr)
+        sys.exit(1)
     # flops/token: same formula as bench.py measure_bert
     m0 = models["b48"][0]
     import jax as _j

@@ -17,7 +17,14 @@ EXAMPLES = [
     #   (accuracy), text_classification (accuracy), qa_ranker
     #   (pairwise NDCG@1), anomaly_detection (recall+precision),
     #   autots_forecast (sMAPE bound), chatbot_seq2seq (loss drop),
-    #   moe_transformer (loss drop on a dp x ep mesh)
+    #   moe_transformer (loss drop on a dp x ep mesh), fraud_detection
+    #   (ROC-AUC on 2%-imbalanced data), sentiment_analysis (accuracy),
+    #   custom_loss (MAE + the asymmetric-loss bias shift),
+    #   augmentation_3d (geometry checks)
+    "fraud/fraud_detection.py",
+    "sentiment/sentiment_analysis.py",
+    "autograd/custom_loss.py",
+    "image3d/augmentation_3d.py",
     "moe/moe_transformer.py",
     "recommendation/ncf_explicit_feedback.py",
     "recommendation/wide_and_deep.py",
