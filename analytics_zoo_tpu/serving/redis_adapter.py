@@ -120,6 +120,16 @@ class _RespConnection:
         return data
 
     def read_command(self) -> Optional[List[bytes]]:
+        """Parse one RESP command array. The caller may arm a socket
+        timeout for the IDLE wait (so a stopped server can reap the
+        thread); the moment a command's first bytes arrive the timeout
+        is cleared -- a mid-payload stall or a backpressured reply
+        must block, never fire a timeout that would desync the parse
+        state or truncate a half-written reply."""
+        if not self.buf:
+            if not self._fill():  # idle point: socket.timeout may
+                return None       # propagate to the caller's loop
+        self.sock.settimeout(None)
         line = self._line()
         if line is None:
             return None
@@ -183,12 +193,14 @@ class RedisFrontend:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                # finite recv timeout: idle connections must re-check
-                # the stop flag, or every stop() would leak a thread
-                # blocked in recv until its client happens to speak
-                self.request.settimeout(0.5)
                 conn = _RespConnection(self.request)
                 while not adapter._stop.is_set():
+                    # finite timeout on the IDLE wait only (so stop()
+                    # can reap threads parked on silent connections);
+                    # read_command clears it once a command begins, so
+                    # slow payloads and backpressured replies block
+                    # instead of desyncing or truncating
+                    self.request.settimeout(0.5)
                     try:
                         cmd = conn.read_command()
                     except socket.timeout:

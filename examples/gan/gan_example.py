@@ -35,7 +35,9 @@ def main():
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
     n = 1024 if args.quick else 8192
-    epochs = 20 if args.quick else 100
+    # the generator needs ~60 epochs before both coordinates settle
+    # on the data mode; quick epochs are 8 steps each, so CI affords it
+    epochs = 80 if args.quick else 150
 
     rng = np.random.RandomState(0)
     data = (rng.randn(n, 2).astype(np.float32) * 0.4
@@ -45,8 +47,13 @@ def main():
     print("final:", {k: round(v, 3)
                      for k, v in history[-1].items() if k != "seconds"})
     samples = gan.generate(512)
-    print("generated mean:", samples.mean(0).round(2),
-          "(target [1.5, -0.5])")
+    gen_mean = samples.mean(0)
+    print("generated mean:", gen_mean.round(2), "(target [1.5, -0.5])")
+    # quality bar: the generator must move its mass to the data mode
+    # (adversarial training collapsed or stalled otherwise)
+    target = np.asarray([1.5, -0.5])
+    assert np.abs(gen_mean - target).max() < 0.6, (
+        f"generator missed the data mode: {gen_mean.round(2)}")
 
 
 if __name__ == "__main__":

@@ -45,6 +45,9 @@ def main():
     theirs = tnet(torch.from_numpy(x)).detach().numpy()
     err = np.abs(ours - theirs).max()
     print(f"torch-import predict parity: max err {err:.2e}")
+    # quality bar: imported weights must reproduce the source
+    # framework's numbers, not just produce a same-shaped output
+    assert err < 1e-4, f"torch import parity broken: {err:.2e}"
 
 
 if __name__ == "__main__":

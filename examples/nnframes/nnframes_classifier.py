@@ -43,6 +43,9 @@ def main():
     out = model.transform(test)
     acc = (out["prediction"].values == test["label"].values).mean()
     print(f"test accuracy: {acc:.3f}")
+    # quality bar: the synthetic classes are separable; a working
+    # DataFrame fit/transform pipeline must crack them
+    assert acc >= 0.85, f"nnframes classifier degraded: {acc:.3f}"
 
 
 if __name__ == "__main__":

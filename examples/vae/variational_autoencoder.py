@@ -93,6 +93,10 @@ def main():
     hist = est.fit((x, x), batch_size=256, epochs=epochs)
     print(f"final ELBO loss: {hist[-1]['loss']:.2f} "
           f"(epoch 1: {hist[0]['loss']:.2f})")
+    # quality bar: the ELBO must fall substantially across the run
+    assert hist[-1]["loss"] < 0.7 * hist[0]["loss"], (
+        f"VAE stopped learning: {hist[0]['loss']:.2f} -> "
+        f"{hist[-1]['loss']:.2f}")
 
     # sample new digits from the prior
     z = np.random.RandomState(7).randn(4, LATENT).astype(np.float32)

@@ -20,7 +20,10 @@ EXAMPLES = [
     #   moe_transformer (loss drop on a dp x ep mesh), fraud_detection
     #   (ROC-AUC on 2%-imbalanced data), sentiment_analysis (accuracy),
     #   custom_loss (MAE + the asymmetric-loss bias shift),
-    #   augmentation_3d (geometry checks)
+    #   augmentation_3d (geometry), image_similarity (top-1 retrieval),
+    #   nnframes_classifier (accuracy), model_import (numeric parity),
+    #   gan (mode recovery), vae (ELBO drop), inception (loss drop),
+    #   long_context (ring exactness)
     "fraud/fraud_detection.py",
     "sentiment/sentiment_analysis.py",
     "autograd/custom_loss.py",

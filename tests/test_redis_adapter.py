@@ -193,6 +193,37 @@ class TestRespServer:
         assert cli.cmd("DEL", key) == 1
         assert cli.cmd("KEYS", RESULT_PREFIX + "*") == []
 
+    def test_idle_connection_does_not_block_stop(self):
+        in_q, out_q = InputQueue(), OutputQueue()
+        fe = RedisFrontend(in_q, out_q, port=0).serve()
+        cli = RespClient(fe.host, fe.port)
+        assert cli.cmd("PING") == "PONG"
+        t0 = time.time()
+        fe.stop()  # idle handler thread must be reaped, not leaked
+        assert time.time() - t0 < 5.0
+
+    def test_slow_mid_command_payload_survives(self, adapter):
+        """A payload stalling >0.5s mid-command must neither desync
+        the parse stream nor time the connection out (the idle
+        timeout applies only before a command's first byte)."""
+        fe, in_q, out_q = adapter
+        cli = RespClient(fe.host, fe.port)
+        x = np.arange(8, dtype=np.float32)
+        payload = reference_tensor_payload(t=x)
+        parts = [b"XADD", b"serving_stream", b"*", b"uri", b"slow-1",
+                 b"data", payload]
+        wire = b"*%d\r\n" % len(parts)
+        for p in parts:
+            wire += b"$%d\r\n%s\r\n" % (len(p), p)
+        half = len(wire) // 2
+        cli.sock.sendall(wire[:half])
+        time.sleep(0.9)  # longer than the idle timeout
+        cli.sock.sendall(wire[half:])
+        entry = cli._reply()
+        assert b"-" in entry  # stream id came back intact
+        # the stream stays usable afterwards (no desync)
+        assert cli.cmd("PING") == "PONG"
+
     def test_full_serving_stack_via_resp(self, tmp_path):
         """launch() with redis enabled: a RESP client predicts through
         the real worker (the reference InputQueue.predict loop)."""
