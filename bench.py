@@ -426,6 +426,61 @@ def cpu_baseline() -> float:
     raise RuntimeError(f"cpu baseline failed: {out.stderr[-2000:]}")
 
 
+def measure_flash_speedup(seq: int = 2048, iters: int = 10,
+                          rounds: int = 3) -> float:
+    """Owned flash kernel vs XLA einsum at a LONG-context shape
+    (fwd+bwd, constant token count, interleaved rounds): the headline
+    for the framework's owned kernel, which ties einsum at the BERT
+    shape but wins where long-context work lives (docs/kernels.md
+    carries the full crossover). Timing fences with a device->host
+    scalar pull (block_until_ready does not wait on remote runtimes)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from analytics_zoo_tpu.ops.attention import _einsum_attention
+    from analytics_zoo_tpu.ops.pallas_attention import (
+        pallas_flash_attention_fwd)
+
+    h, d = 12, 64
+    b = max(1, (48 * 384) // seq)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, seq, d), jnp.bfloat16)
+
+    def runner(attn):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32))
+
+        grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        def run():
+            out = None
+            for _ in range(iters):
+                out = grad(q, q, q)
+            return float(jnp.sum(out[0].astype(jnp.float32)))
+
+        run()  # compile
+        return run
+
+    impls = {
+        "einsum": runner(_einsum_attention),
+        "flash": runner(
+            lambda a, b_, c: pallas_flash_attention_fwd(a, b_, c,
+                                                        False)),
+    }
+    # INTERLEAVED rounds: each round times both impls side by side so
+    # a chip-clock shift lands on both, not on one (the same rationale
+    # as the epoch benches' interleaved windows)
+    best = {}
+    for _ in range(rounds):
+        for name, run in impls.items():
+            t0 = time.perf_counter()
+            run()
+            dt = time.perf_counter() - t0
+            best[name] = min(best.get(name, dt), dt)
+    return best["einsum"] / best["flash"]
+
+
 def measure_scaling_virtual(n: int = 8, timeout: float = 900.0):
     """Run the weak-scaling harness over n virtual CPU devices in a
     subprocess (this process holds the TPU backend). Validates the
@@ -473,6 +528,11 @@ def main():
     except Exception as e:
         print(f"warning: serving bench failed: {e}", file=sys.stderr)
         serving = None
+    try:
+        flash_speedup = measure_flash_speedup()
+    except Exception as e:
+        print(f"warning: flash A/B failed: {e}", file=sys.stderr)
+        flash_speedup = None
     try:
         scaling_eff = measure_scaling_virtual(8)
     except Exception as e:
@@ -524,6 +584,8 @@ def main():
             "serving_windows_rejected": serving["rejected"],
             "serving_degraded": serving["degraded"],
         })
+    if flash_speedup is not None:
+        extras["attn_flash_speedup_l2048"] = round(flash_speedup, 3)
     if scaling_eff is not None:
         extras["scaling_efficiency_virtual8"] = round(scaling_eff, 4)
     line = json.dumps({

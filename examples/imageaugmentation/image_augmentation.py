@@ -41,6 +41,11 @@ def main():
     out = ImageSet.from_arrays(images).transform(chain)
     shapes = {f.image.shape for f in out.features}
     print(f"classification chain: {n} images -> shapes {shapes}")
+    # bar: every op ran -- the chain must land on the crop size and
+    # keep pixel values in range (a broken op silently passes neither)
+    assert shapes == {(32, 48, 3)}, shapes
+    assert all(0 <= f.image.min() and f.image.max() <= 255
+               for f in out.features)
 
     # --- detection chain: boxes follow every geometric op
     feat = ImageFeature(images[0], bboxes=[[10, 8, 30, 28]],

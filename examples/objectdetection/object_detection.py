@@ -34,6 +34,16 @@ def main():
     for i, dets in enumerate(results):
         pretty = [(det.label_of(c), round(s, 3)) for c, s, _ in dets]
         print(f"image {i}: {pretty}")
+    # structural bar: detections are (class, score, box) with scores
+    # in [0, 1], at most top_k per image, boxes inside the image
+    for dets in results:
+        assert len(dets) <= 5
+        for c, s, box in dets:
+            assert 0.0 <= s <= 1.0
+            assert det.label_of(c) in LABELS.values()
+            x0, y0, x1, y1 = box
+            assert x0 <= x1 and y0 <= y1
+            assert -1 <= x0 and x1 <= 129 and -1 <= y0 and y1 <= 129
 
     if args.out:
         from PIL import Image

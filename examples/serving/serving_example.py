@@ -48,15 +48,24 @@ def main():
 
     # --- queue-client path (InputQueue/OutputQueue, client.py parity)
     rng = np.random.RandomState(0)
+    first = None
     for i in range(args.requests):
-        in_q.enqueue(f"req-{i}",
-                     input=rng.randn(4).astype(np.float32))
+        x_i = rng.randn(4).astype(np.float32)
+        if first is None:
+            first = x_i
+        in_q.enqueue(f"req-{i}", input=x_i)
     got = {}
     while len(got) < args.requests:
         uri, tensors = out_q.dequeue(timeout=10)
         got[uri] = tensors
     print(f"queue path: {len(got)} responses, "
           f"output shape {got['req-0']['output'].shape}")
+    # quality bar: a served response must match the model called
+    # directly -- the data plane may batch and pad, never alter
+    direct = np.asarray(model.predict(first[None]))
+    np.testing.assert_allclose(
+        np.asarray(got["req-0"]["output"]), direct[0],
+        rtol=1e-4, atol=1e-5)
 
     # --- HTTP path (/predict + /metrics, FrontEndApp parity)
     frontend = HttpFrontend(in_q, out_q, worker=worker).start()

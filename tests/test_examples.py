@@ -10,20 +10,17 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = [
-    # QUALITY-BARRED examples assert a learning outcome inside main()
-    # (so this run fails if the model stops learning, the analog of
-    # the reference's apps/run-app-tests.sh thresholds):
-    #   ncf (accuracy), dogs_vs_cats (accuracy), wide_and_deep
-    #   (accuracy), text_classification (accuracy), qa_ranker
-    #   (pairwise NDCG@1), anomaly_detection (recall+precision),
-    #   autots_forecast (sMAPE bound), chatbot_seq2seq (loss drop),
-    #   moe_transformer (loss drop on a dp x ep mesh), fraud_detection
-    #   (ROC-AUC on 2%-imbalanced data), sentiment_analysis (accuracy),
-    #   custom_loss (MAE + the asymmetric-loss bias shift),
-    #   augmentation_3d (geometry), image_similarity (top-1 retrieval),
-    #   nnframes_classifier (accuracy), model_import (numeric parity),
-    #   gan (mode recovery), vae (ELBO drop), inception (loss drop),
-    #   long_context (ring exactness)
+    # EVERY example asserts a learning-outcome or correctness bar
+    # inside main() (so this run fails if the model stops learning --
+    # the analog of the reference's apps/run-app-tests.sh thresholds):
+    # accuracy (ncf, dogs_vs_cats, wide_and_deep, text_classification,
+    # sentiment, nnframes_classifier), ranking (qa_ranker NDCG@1,
+    # image_similarity top-1, fraud ROC-AUC), loss drops (chatbot,
+    # moe_transformer, vae ELBO, inception), span accuracy
+    # (bert_squad), recall+precision (anomaly_detection), sMAPE bound
+    # (autots), numeric parity (model_import, serving round trip),
+    # bias shift (custom_loss), geometry/structure (augmentation_3d,
+    # imageaugmentation, objectdetection), exactness (long_context)
     "fraud/fraud_detection.py",
     "sentiment/sentiment_analysis.py",
     "autograd/custom_loss.py",
