@@ -49,10 +49,12 @@ def _auto_block(length: int, cap: int = 1024) -> int:
     matmuls (measured ~2.5x fwd+bwd at L=4096 vs 128-blocks) while
     staying inside VMEM (s/p tiles at [1024, 1024] f32 = 4 MB each).
 
-    The backward kernels pass cap=512: they hold three [BQ, BK] f32
-    intermediates (s, p, dp) plus q/k/v/do/lse/delta tiles and scratch,
-    which at 1024^2 blocks (~12 MB of intermediates alone) would run
-    into the ~16 MB per-core VMEM budget of v4/v5e."""
+    The backward kernels pass ``_bwd_cap``: 512 at d >= 128 -- they
+    hold three [BQ, BK] f32 intermediates (s, p, dp) plus
+    q/k/v/do/lse/delta tiles and scratch, which at 1024^2 blocks
+    (~12 MB of intermediates alone) would crowd the ~16 MB per-core
+    VMEM budget -- but 1024 at d <= 64 / L >= 2048, where the halved
+    tiles fit and measure 6-7% faster (see _bwd_cap)."""
     for b in (1024, 896, 768, 640, 512, 384, 256, 128):
         if b <= cap and length % b == 0:
             return b
@@ -290,12 +292,22 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _bwd_cap(length: int, d: int) -> int:
+    """Backward block cap: 512 keeps the three [BQ, BK] f32
+    intermediates inside VMEM at d=128; at d <= 64 every q/k/v/do tile
+    halves, so 1024-blocks fit AND measure 6-7% faster at L >= 2048
+    (scripts/perf_flash_blocks.py) -- but only when the sequential
+    grid dim keeps >= 2 steps, else Mosaic has nothing to pipeline
+    and L=1024 regresses ~25%."""
+    return 1024 if (d <= 64 and length >= 2048) else 512
+
+
 def _flash_bwd(q, k, v, o, lse, g, causal: bool, scale: float,
                block_q: int, block_k: int):
     b, h, l, d = q.shape
     lk = k.shape[2]
-    block_q = block_q or _auto_block(l, cap=512)
-    block_k = block_k or _auto_block(lk, cap=512)
+    block_q = block_q or _auto_block(l, cap=_bwd_cap(l, d))
+    block_k = block_k or _auto_block(lk, cap=_bwd_cap(lk, d))
     bh = b * h
     qr = q.reshape(bh, l, d)
     kr = k.reshape(bh, lk, d)

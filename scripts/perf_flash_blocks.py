@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Block-size autotune for the owned flash kernel at long context.
+
+The fwd caps blocks at 1024 and the bwd at 512 (VMEM budget sized for
+d=128). At d=64 the q/k/v/do tiles and scratch halve, so larger bwd
+blocks may fit and pipeline better. A/B at L in {1024, 2048, 4096},
+fwd+bwd, interleaved rounds, scalar-pull fence.
+
+Usage: python scripts/perf_flash_blocks.py [rounds]
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.pallas_attention import (
+    pallas_flash_attention_fwd)
+
+H, D = 12, 64
+TOKENS = 48 * 384
+ITERS = 10
+
+
+def runner(L, block_q, block_k):
+    b = max(1, TOKENS // L)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, H, L, D), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(pallas_flash_attention_fwd(
+            q, k, v, False, None, block_q, block_k).astype(jnp.float32))
+
+    grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def run():
+        out = None
+        for _ in range(ITERS):
+            out = grad(q, q, q)
+        return float(jnp.sum(out[0].astype(jnp.float32)))
+
+    run()
+    return run
+
+
+def main():
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    for L in (1024, 2048, 4096):
+        cfgs = {}
+        for bq in (None, 256, 512, 1024):
+            if bq is not None and bq > L:
+                continue
+            name = f"bq{bq or 'auto'}"
+            try:
+                cfgs[name] = runner(L, bq, bq)
+            except Exception as e:
+                print(f"L={L} {name}: failed {str(e)[:80]}", flush=True)
+        best = {}
+        for _ in range(rounds):
+            for name, run in cfgs.items():
+                t0 = time.perf_counter()
+                run()
+                dt = (time.perf_counter() - t0) / ITERS
+                best[name] = min(best.get(name, dt), dt)
+        print(f"L={L}: " + "  ".join(
+            f"{n}={v*1e3:.2f}ms" for n, v in sorted(best.items())),
+            flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -187,6 +187,34 @@ class TestPallasKernel:
                 np.asarray(gf), np.asarray(gr), atol=2e-4,
                 err_msg=f"d{name} mismatch")
 
+    def test_flash_backward_long_context_1024_blocks(self):
+        # the d<=64 / L>=2048 backward runs 1024-blocks (_bwd_cap);
+        # grads through that geometry must still match the dense path
+        from analytics_zoo_tpu.ops import (
+            pallas_flash_attention_fwd, reference_attention)
+        from analytics_zoo_tpu.ops.pallas_attention import _bwd_cap
+
+        assert _bwd_cap(2048, 64) == 1024   # the branch under test
+        assert _bwd_cap(1024, 64) == 512    # pipelining guard
+        assert _bwd_cap(2048, 128) == 512   # VMEM guard
+        rng = np.random.RandomState(5)
+        b, h, l, d = 1, 1, 2048, 64
+        q = jnp.asarray(rng.randn(b, h, l, d) * 0.2, jnp.float32)
+        k = jnp.asarray(rng.randn(b, h, l, d) * 0.2, jnp.float32)
+        v = jnp.asarray(rng.randn(b, h, l, d) * 0.2, jnp.float32)
+
+        def f(fn):
+            return jax.grad(
+                lambda a, b_, c: fn(a, b_, c).sum(), argnums=(0, 1, 2)
+            )(q, k, v)
+
+        g_flash = f(lambda a, b_, c: pallas_flash_attention_fwd(
+            a, b_, c, False))
+        g_ref = f(lambda a, b_, c: reference_attention(a, b_, c))
+        for gf, gr in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                       atol=2e-4)
+
     def test_flash_backward_cross_length_grads(self):
         from analytics_zoo_tpu.ops import (
             pallas_flash_attention_fwd, reference_attention)
