@@ -61,6 +61,18 @@ _DEFAULTS: Dict[str, Any] = {
     # serving
     "zoo.serving.batch_size": 8,
     "zoo.serving.batch_timeout_ms": 5,
+    # adaptive micro-batching (AdaptiveBatcher): the linger floor the
+    # deadline tightens toward when the input queue is shallow, and the
+    # cap the batch may grow to under backlog (0 = auto: the power-of-
+    # two bucket of 4x batch_size). Growth is snapped to the bucket
+    # ladder so it never introduces a new XLA shape.
+    "zoo.serving.batch_timeout_min_ms": 1.0,
+    "zoo.serving.batch_max_size": 0,
+    # pipelined serving engine: decode -> assemble/dispatch -> finalize
+    # run as overlapped stages with up to pipeline.depth dispatched
+    # batches in flight; false restores the synchronous per-batch loop
+    "zoo.serving.pipeline.enabled": True,
+    "zoo.serving.pipeline.depth": 2,
     "zoo.serving.http_port": 10020,
     # inference
     "zoo.inference.default_dtype": "bfloat16",

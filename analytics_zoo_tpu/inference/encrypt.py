@@ -10,13 +10,30 @@ from __future__ import annotations
 
 import os
 
-from cryptography.hazmat.primitives import hashes, padding
-from cryptography.hazmat.primitives.ciphers import (
-    Cipher, algorithms, modes)
-from cryptography.hazmat.primitives.kdf.pbkdf2 import PBKDF2HMAC
+try:  # optional dependency: importing this module must never fail --
+    # serving/inference deployments without encrypted models should not
+    # need the cryptography wheel (errors surface at call time instead)
+    from cryptography.hazmat.primitives import hashes, padding
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher, algorithms, modes)
+    from cryptography.hazmat.primitives.kdf.pbkdf2 import PBKDF2HMAC
+    _CRYPTO_ERR = None
+except ImportError as _e:  # pragma: no cover - environment dependent
+    _CRYPTO_ERR = _e
 
 _ITERATIONS = 65536  # ref: EncryptSupportive.scala iteration count
 _KEY_LEN = 32
+
+
+def crypto_available() -> bool:
+    return _CRYPTO_ERR is None
+
+
+def _require_crypto() -> None:
+    if _CRYPTO_ERR is not None:
+        raise RuntimeError(
+            "encrypted model support needs the 'cryptography' package "
+            f"(import failed: {_CRYPTO_ERR})")
 
 
 def _derive(secret: str, salt: bytes) -> bytes:
@@ -26,6 +43,7 @@ def _derive(secret: str, salt: bytes) -> bytes:
 
 
 def encrypt_bytes(data: bytes, secret: str) -> bytes:
+    _require_crypto()
     salt = os.urandom(16)
     iv = os.urandom(16)
     key = _derive(secret, salt)
@@ -36,6 +54,7 @@ def encrypt_bytes(data: bytes, secret: str) -> bytes:
 
 
 def decrypt_bytes(blob: bytes, secret: str) -> bytes:
+    _require_crypto()
     salt, iv, ct = blob[:16], blob[16:32], blob[32:]
     key = _derive(secret, salt)
     dec = Cipher(algorithms.AES(key), modes.CBC(iv)).decryptor()

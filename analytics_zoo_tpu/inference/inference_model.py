@@ -38,6 +38,20 @@ def _bucket(n: int) -> int:
     return b
 
 
+def bucket_ladder(max_batch: int) -> List[int]:
+    """Every power-of-two batch bucket up to (and including) the one
+    covering ``max_batch`` -- the shape ladder ``predict`` pads onto.
+    The serving launcher warms these; the adaptive batcher snaps its
+    backlog-grown caps to them so batching policy never invents an XLA
+    shape."""
+    ladder = []
+    b = 1
+    while b <= _bucket(max_batch):
+        ladder.append(b)
+        b *= 2
+    return ladder
+
+
 class InferenceModel:
     def __init__(self, concurrent_num: int = 1, dtype=None):
         # concurrent_num kept for API parity (ref: InferenceModel.scala

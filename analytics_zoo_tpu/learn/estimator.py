@@ -320,7 +320,16 @@ class Estimator:
         memory and one optimizer update per k microbatches. Holds
         exactly for per-sample models; batch-coupled layers (e.g.
         BatchNorm) compute statistics over B/k rows instead of B, so
-        their trajectory legitimately differs from the k=1 run."""
+        their trajectory legitimately differs from the k=1 run.
+
+        Mutable-collection caveat: every microbatch's forward reads the
+        SAME pre-step collections (``params`` is the scan's only
+        threaded state), so each microbatch's mutable update -- e.g.
+        the BatchNorm EMA -- is computed independently from the
+        pre-step statistics, and only the LAST microbatch's update is
+        kept. This is NOT equivalent to a sequential k-step loop, which
+        would compound k EMA updates (each folding into the previous
+        step's stats) and advance the EMA roughly k times faster."""
 
         def split(a):
             if a.shape[0] % k:
@@ -347,8 +356,10 @@ class Estimator:
             body, (zeros, jnp.zeros((), jnp.float32)),
             (jnp.arange(k), xs, ys))
         grads = jax.tree_util.tree_map(lambda g: g / k, g_sum)
-        # mutable state (e.g. batch stats) keeps the LAST microbatch's
-        # update, the same convention a k-step loop would leave behind
+        # mutable state (e.g. batch stats): each microbatch updated from
+        # the same PRE-STEP collections, so taking [-1] keeps one
+        # single-microbatch update -- NOT the compounded k updates a
+        # sequential k-step loop would produce (see docstring caveat)
         new_extra = jax.tree_util.tree_map(lambda a: a[-1], extras)
         return loss_sum / k, new_extra, grads
 

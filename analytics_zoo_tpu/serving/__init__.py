@@ -15,7 +15,10 @@ from analytics_zoo_tpu.serving.queues import (  # noqa: F401
     DirQueue,
     MemQueue,
 )
-from analytics_zoo_tpu.serving.batcher import MicroBatcher  # noqa: F401
+from analytics_zoo_tpu.serving.batcher import (  # noqa: F401
+    AdaptiveBatcher,
+    MicroBatcher,
+)
 from analytics_zoo_tpu.serving.worker import ServingWorker  # noqa: F401
 from analytics_zoo_tpu.serving.launcher import (  # noqa: F401
     ServingApp,

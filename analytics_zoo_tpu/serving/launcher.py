@@ -21,9 +21,14 @@ Config schema (defaults in parentheses)::
                                          # host:port when queue: tcp
       maxlen: 10000
     params:
-      batch_size: 8                      # micro-batch cap (core_number)
-      timeout_ms: 5.0
+      batch_size: 8                      # base micro-batch cap (core_number)
+      timeout_ms: 5.0                    # max linger per batch
+      min_timeout_ms: 1.0                # adaptive linger floor (shallow queue)
+      max_batch_size: 0                  # backlog growth cap (0 = 4x batch_size
+                                         # bucket); growth stays on the ladder
       top_n: null                        # classes/scores of top-N
+      pipelined: null                    # null = zoo.serving.pipeline.enabled;
+                                         # false restores the synchronous engine
       pipeline_depth: 2                  # in-flight predict batches
                                          # (1 disables overlap)
       warm_batch_sizes: [1, 8]           # pre-compiled buckets (uses the
@@ -141,17 +146,25 @@ def launch(config: Dict[str, Any]) -> ServingApp:
         out_q = OutputQueue(backend=queue_kind,
                             path=(data.get("path") + ".out"
                                   if data.get("path") else None))
-    from analytics_zoo_tpu.inference.inference_model import _bucket
+    worker = ServingWorker(
+        model, in_q, out_q, batch_size=params.get("batch_size"),
+        timeout_ms=params.get("timeout_ms"),
+        top_n=params.get("top_n"),
+        pipeline_depth=params.get("pipeline_depth"),
+        pipelined=params.get("pipelined"),
+        min_timeout_ms=params.get("min_timeout_ms"),
+        max_batch_size=params.get("max_batch_size"))
+    from analytics_zoo_tpu.inference.inference_model import bucket_ladder
 
-    # default: every power-of-two bucket the micro-batcher can emit, so
-    # no live request ever pays an XLA compile
-    batch_size = params.get("batch_size", 8)
-    default_warm = []
-    b = 1
-    while b <= _bucket(batch_size):
-        default_warm.append(b)
-        b *= 2
-    warm = params.get("warm_batch_sizes", default_warm)
+    # default: every power-of-two bucket the batcher can emit -- up to
+    # its backlog GROWTH cap, not just the base size -- so no request
+    # ever pays a live XLA compile, least of all at the first backlog
+    # spike (exactly when a multi-second compile stall hurts most).
+    # Cap growth-warming with params.max_batch_size for deployments
+    # that cannot afford the extra startup compiles.
+    warm_cap = getattr(worker.batcher, "max_batch_size",
+                       worker.batcher.batch_size)
+    warm = params.get("warm_batch_sizes", bucket_ladder(warm_cap))
     if warm:
         warm_example = params.get("warm_example", model.example_input)
         if warm_example is not None:
@@ -160,11 +173,7 @@ def launch(config: Dict[str, Any]) -> ServingApp:
             logger.warning(
                 "warm_batch_sizes set but no example input is "
                 "available; skipping warm-up")
-    worker = ServingWorker(
-        model, in_q, out_q, batch_size=params.get("batch_size", 8),
-        timeout_ms=params.get("timeout_ms", 5.0),
-        top_n=params.get("top_n"),
-        pipeline_depth=params.get("pipeline_depth", 2)).start()
+    worker.start()
     frontend = None
     redis_fe = None
     try:

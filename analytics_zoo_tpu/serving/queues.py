@@ -16,6 +16,7 @@ from __future__ import annotations
 import collections
 import io
 import os
+import struct
 import threading
 import time
 import uuid
@@ -23,19 +24,49 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+# Wire format. v1 was np.savez (one zip archive per request): simple,
+# but the zip machinery costs ~260 us per request round-trip -- it was
+# the single largest host cost of the serving cycle (measured on the
+# ISSUE-1 pipeline bench; see BENCH_NOTES.md). v2 ("AZT1") frames raw
+# ndarray buffers with a dtype/shape header: ~15 us round-trip, no
+# pickle surface, and decode still accepts v1 blobs (zip magic) so
+# spooled items from older deployments keep draining.
+_MAGIC = b"AZT1"
+_ZIP_MAGIC = b"PK"  # np.savez container (legacy v1 blobs)
+
 
 def _encode(uri: str, payload: Dict[str, np.ndarray],
             reply_to: Optional[str] = None) -> bytes:
-    buf = io.BytesIO()
-    extra = {}
+    items = [("__uri__", np.asarray(uri))]
     if reply_to:
         # reply-to stream for brokered deployments: the worker that
         # serves the request routes the result back to the REQUESTER'S
         # result stream (several frontends can share one broker)
-        extra["__reply__"] = np.asarray(reply_to)
-    np.savez(buf, __uri__=np.asarray(uri), **extra,
-             **{k: np.asarray(v) for k, v in payload.items()})
-    return buf.getvalue()
+        items.append(("__reply__", np.asarray(reply_to)))
+    for k, v in payload.items():
+        a = np.asarray(v)
+        if not a.flags["C_CONTIGUOUS"]:
+            # NOT np.ascontiguousarray: that promotes 0-d to (1,),
+            # silently changing scalar tensors' round-tripped shape
+            # (0-d arrays are already contiguous and skip this)
+            a = np.ascontiguousarray(a)
+        items.append((k, a))
+    parts = [_MAGIC, struct.pack("<I", len(items))]
+    for name, a in items:
+        if a.dtype.hasobject:
+            raise ValueError(
+                f"tensor {name!r} has object dtype; only plain "
+                "numeric/string arrays go on the serving wire")
+        nb = name.encode("utf-8")
+        db = a.dtype.str.encode("ascii")
+        body = a.tobytes()
+        parts.append(struct.pack("<HBB", len(nb), len(db), a.ndim))
+        parts.append(nb)
+        parts.append(db)
+        parts.append(struct.pack("<%dq" % a.ndim, *a.shape))
+        parts.append(struct.pack("<Q", len(body)))
+        parts.append(body)
+    return b"".join(parts)
 
 
 _META_KEYS = ("__uri__", "__reply__")
@@ -46,9 +77,46 @@ def _decode(blob: bytes) -> Tuple[str, Dict[str, np.ndarray]]:
     return uri, tensors
 
 
+def _decode_raw(blob: bytes) -> Dict[str, np.ndarray]:
+    (count,) = struct.unpack_from("<I", blob, 4)
+    off = 8
+    out: Dict[str, np.ndarray] = {}
+    for _ in range(count):
+        nlen, dlen, ndim = struct.unpack_from("<HBB", blob, off)
+        off += 4
+        name = blob[off:off + nlen].decode("utf-8")
+        off += nlen
+        dtype = np.dtype(blob[off:off + dlen].decode("ascii"))
+        off += dlen
+        shape = struct.unpack_from("<%dq" % ndim, blob, off)
+        off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", blob, off)
+        off += 8
+        n = 1
+        for s in shape:
+            n *= s
+        # .copy(): frombuffer views are read-only; requests keep the
+        # writable-array contract the npz decoder gave user hooks
+        out[name] = np.frombuffer(
+            blob, dtype=dtype, count=n,
+            offset=off).reshape(shape).copy()
+        off += nbytes
+    return out
+
+
 def _decode_full(blob: bytes
                  ) -> Tuple[str, Dict[str, np.ndarray], Optional[str]]:
-    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+    if blob[:4] == _MAGIC:
+        z = _decode_raw(blob)
+        uri = str(z["__uri__"].reshape(())) if "__uri__" in z else ""
+        reply = (str(z["__reply__"].reshape(()))
+                 if "__reply__" in z else None)
+        return uri, {k: v for k, v in z.items()
+                     if k not in _META_KEYS}, reply
+    if not blob.startswith(_ZIP_MAGIC):
+        raise ValueError("not a serving wire blob (neither AZT1 nor "
+                         "legacy npz framing)")
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:  # legacy v1
         uri = str(z["__uri__"])
         reply = str(z["__reply__"]) if "__reply__" in z.files else None
         return uri, {k: z[k] for k in z.files
@@ -79,6 +147,30 @@ class MemQueue:
             if not self._q:
                 return None
             return self._q.popleft()
+
+    def get_many(self, n: int) -> List[bytes]:
+        """Drain up to ``n`` items without blocking -- one lock
+        acquisition instead of ``n`` condvar round-trips (the batcher's
+        deep-backlog fast path)."""
+        with self._cv:
+            k = min(n, len(self._q))
+            return [self._q.popleft() for _ in range(k)]
+
+    def put_many(self, items: List[bytes]) -> int:
+        """Append up to capacity in one lock trip; returns how many
+        were accepted (the finalize stage pushes whole batches --
+        per-item lock/notify costs add up at adaptive batch sizes)."""
+        with self._cv:
+            if self._maxlen is None:
+                self._q.extend(items)
+                accepted = len(items)
+            else:
+                room = max(0, self._maxlen - len(self._q))
+                accepted = min(room, len(items))
+                self._q.extend(items[:accepted])
+            if accepted:
+                self._cv.notify(accepted)
+            return accepted
 
     def __len__(self) -> int:
         with self._cv:
@@ -123,6 +215,26 @@ class DirQueue:
             if deadline is not None and time.time() >= deadline:
                 return None
             time.sleep(0.005)
+
+    def get_many(self, n: int) -> List[bytes]:
+        """Claim up to ``n`` items in one directory scan (non-blocking;
+        losing a claim race to another consumer just skips that item)."""
+        out: List[bytes] = []
+        for name in sorted(os.listdir(self.path)):
+            if len(out) >= n:
+                break
+            if not name.endswith(".item"):
+                continue
+            src = os.path.join(self.path, name)
+            claimed = src + ".claimed"
+            try:
+                os.rename(src, claimed)
+            except OSError:
+                continue
+            with open(claimed, "rb") as f:
+                out.append(f.read())
+            os.unlink(claimed)
+        return out
 
     def __len__(self) -> int:
         return sum(1 for n in os.listdir(self.path)
@@ -419,6 +531,13 @@ class OutputQueue:
         return None if blob is None else _decode(blob)
 
     def dequeue_all(self) -> List[Tuple[str, Dict[str, np.ndarray]]]:
+        if hasattr(self._q, "get_many"):
+            out = []
+            while True:  # batched drain: one lock trip per chunk
+                blobs = self._q.get_many(256)
+                out.extend(_decode(b) for b in blobs)
+                if len(blobs) < 256:
+                    return out
         out = []
         while True:
             item = self.dequeue(timeout=0)

@@ -58,6 +58,12 @@ def decode_arrow_payload(b64: bytes) -> Dict[str, np.ndarray]:
     out: Dict[str, np.ndarray] = {}
     for name, col in zip(batch.schema.names, batch.columns):
         rows = col.to_pylist()
+        if not rows:  # a rowless column has no tensor to build; fail
+            # with the column name rather than an IndexError up-stack
+            raise ValueError(
+                f"input column {name!r} is empty (zero rows); every "
+                "column needs tensor-struct rows or base64 payload "
+                "rows")
         if isinstance(rows[0], dict):  # tensor struct (dense or sparse)
             merged: Dict[str, Any] = {}
             for row in rows:
@@ -71,8 +77,11 @@ def decode_arrow_payload(b64: bytes) -> Dict[str, np.ndarray]:
             data = np.asarray(merged.get("data", []), np.float32)
             shape = [int(s) for s in merged.get("shape", [])]
             out[name] = data.reshape(shape) if shape else data
-        else:  # string: base64 image bytes (the reference's image path)
-            raw = base64.b64decode(rows[0])
+        else:  # string: base64 image bytes (the reference's image path).
+            # Decode EVERY row, not just row 0 -- a client may chunk a
+            # large payload across rows; the decoded chunks concatenate
+            # back into the original byte stream
+            raw = b"".join(base64.b64decode(r) for r in rows if r)
             out[name] = np.frombuffer(raw, np.uint8)
     return out
 
@@ -133,8 +142,17 @@ class _RespConnection:
         line = self._line()
         if line is None:
             return None
-        if not line.startswith(b"*"):  # inline command (telnet style)
-            return line.split() or self.read_command()
+        while not line.startswith(b"*"):  # inline command (telnet style)
+            parts = line.split()
+            if parts:
+                return parts
+            # blank line: keep reading via a LOOP, never recursion -- a
+            # client streaming bare CRLFs must not be able to blow the
+            # interpreter's recursion limit and kill this connection
+            # thread
+            line = self._line()
+            if line is None:
+                return None
         n = int(line[1:])
         parts = []
         for _ in range(n):
@@ -314,12 +332,18 @@ class RedisFrontend:
             conn.error("only XGROUP CREATE is supported")
             return
         key = (cmd[2].decode(), cmd[3].decode())
-        if key in self._groups:
+        # membership check + add under the lock: two clients racing on
+        # XGROUP CREATE must see exactly one +OK and one BUSYGROUP
+        # (an unlocked check-then-add could answer +OK to both)
+        with self._lock:
+            exists = key in self._groups
+            if not exists:
+                self._groups.add(key)
+        if exists:
             # match real redis so client retry logic behaves
             self.sock_err(conn, "BUSYGROUP Consumer Group name "
                                 "already exists")
             return
-        self._groups.add(key)
         conn.ok()
 
     @staticmethod

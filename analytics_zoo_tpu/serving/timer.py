@@ -53,6 +53,7 @@ class Timer:
 
     def __init__(self, keep_samples: int = 0):
         self._stats: Dict[str, _StageStat] = {}
+        self._gauges: Dict[str, _StageStat] = {}
         self._keep = keep_samples
         self._lock = threading.Lock()
 
@@ -76,6 +77,14 @@ class Timer:
             self._stats.setdefault(
                 name, _StageStat(self._keep)).record(dt)
 
+    def gauge(self, name: str, value: float) -> None:
+        """Record a sampled VALUE (queue depth, batch occupancy,
+        in-flight count) rather than a duration; summarized under the
+        ``gauges`` key of :meth:`summary` with unit-less stat names."""
+        with self._lock:
+            self._gauges.setdefault(
+                name, _StageStat(self._keep)).record(float(value))
+
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             out = {}
@@ -96,8 +105,26 @@ class Timer:
                     out[name]["p50_s"] = ordered[len(ordered) // 2]
                     out[name]["p99_s"] = ordered[
                         min(len(ordered) - 1, int(len(ordered) * 0.99))]
+            gauges = {}
+            for name, s in self._gauges.items():
+                if not s.count:
+                    continue
+                gauges[name] = {
+                    "count": s.count,
+                    "avg": s.total / s.count,
+                    "max": s.max,
+                    "min": s.min,
+                }
+                if s.samples:
+                    ordered = sorted(s.samples)
+                    gauges[name]["p50"] = ordered[len(ordered) // 2]
+                    gauges[name]["p99"] = ordered[
+                        min(len(ordered) - 1, int(len(ordered) * 0.99))]
+            if gauges:
+                out["gauges"] = gauges
             return out
 
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
+            self._gauges.clear()

@@ -4,25 +4,44 @@ The analog of the Flink inference task (ref: zoo/.../serving/engine/
 FlinkInference.scala:32-80 -- per-TM singleton InferenceModel fed by
 micro-batches from the Redis source; batching logic in
 engine/ClusterServingInference.scala:33-160). The TPU redesign runs one
-worker loop per serving host: pull from an InputQueue via MicroBatcher,
-stack request tensors into one padded device batch, run the AOT-cached
-``InferenceModel.predict``, split results back per-request and push them
-to the OutputQueue. Every stage is Timer-instrumented (ref:
-serving/engine/Timer.scala:24-90).
+worker loop per serving host, in one of two modes:
+
+- **pipelined** (default, ``zoo.serving.pipeline.enabled``): an
+  explicitly staged engine. A *decode* stage (its own thread, image
+  decode fanned out over the shared thread pool) pulls micro-batches
+  via :class:`AdaptiveBatcher` and feeds an *assembly* stage that
+  stacks shape-compatible requests into padded, bucket-ladder device
+  batches and dispatches them through the non-blocking
+  ``InferenceModel.predict_async`` -- JAX's async dispatch keeps up to
+  ``pipeline_depth`` batches in flight -- while a *finalize* stage on a
+  third thread drains completed results in dispatch order. Decode of
+  batch k+1 therefore overlaps device compute of batch k and result
+  fetch/postprocess/push of batch k-1 (the stage overlap BigDL 2.0's
+  Cluster Serving gets from the Flink dataflow, arXiv:2204.01715).
+- **synchronous** (the escape hatch): one pull -> decode -> predict ->
+  finalize cycle at a time on the caller's thread, still with
+  ``pipeline_depth`` async dispatches in flight between cycles.
+
+Results never reorder: the in-flight window is a FIFO and finalize is
+single-threaded, so responses leave in dispatch order. Every stage is
+Timer-instrumented (ref: serving/engine/Timer.scala:24-90), including
+queue-depth / batch-occupancy / in-flight gauges.
 """
 
 from __future__ import annotations
 
 import collections
 import os
+import queue as _pyqueue
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.log import get_logger
-from analytics_zoo_tpu.serving.batcher import MicroBatcher
+from analytics_zoo_tpu.serving.batcher import AdaptiveBatcher, MicroBatcher
 from analytics_zoo_tpu.serving.queues import (
     TcpQueue, _decode_full, _encode)
 from analytics_zoo_tpu.serving.timer import Timer
@@ -145,37 +164,85 @@ def _default_output_fn(pred: Any) -> Dict[str, np.ndarray]:
     return {"output": np.asarray(pred)}
 
 
+# in-flight records: either a dispatched batch awaiting finalize, or a
+# bundle of per-request errors funneled through the same FIFO so
+# responses keep dispatch order and one thread owns the served counter
+_BATCH = "batch"    # ("batch", uris, replies, preds, n, prep_s)
+_ERRORS = "errors"  # ("errors", [(uri, reply, message), ...])
+
+_SENTINEL = object()  # closes a pipeline stage
+
+
 class ServingWorker:
     """Pulls, batches, predicts, pushes. Run inline (``serve_forever``),
     one bounded number of batches (``run``), or on a daemon thread
     (``start``/``stop``).
 
     Args:
-      model: an ``InferenceModel`` (anything with ``predict(x)``).
+      model: an ``InferenceModel`` (anything with ``predict(x)``;
+        ``predict_async`` enables non-blocking dispatch).
       input_queue / output_queue: ``InputQueue``/``OutputQueue`` (or any
         object exposing their ``queue`` backend).
-      batch_size: micro-batch cap (ref: ClusterServingHelper coreNumber
-        as batch size).
-      timeout_ms: linger after the first request of a batch.
+      batch_size: base micro-batch cap (ref: ClusterServingHelper
+        coreNumber as batch size).
+      timeout_ms: maximum linger after the first request of a batch.
+      min_timeout_ms: linger floor the adaptive deadline tightens
+        toward when the input queue is shallow.
+      max_batch_size: cap the adaptive batcher may grow to under
+        backlog (bucket-snapped); None reads config, 0 = 4x batch_size.
       input_fn / output_fn: request-tensors -> model-input pytree and
         model-output-slice -> response-tensors hooks (PreProcessing /
         PostProcessing analogs).
       top_n: if set, responses carry ``classes``/``scores`` of the top-N
         logits instead of the raw output (ref: PostProcessing topN).
+      pipeline_depth: bounded in-flight window -- how many dispatched
+        batches may await finalize (None reads config).
+      pipelined: True runs the staged decode/assemble/finalize engine;
+        False the synchronous loop; None reads
+        ``zoo.serving.pipeline.enabled``.
     """
 
     def __init__(self, model, input_queue, output_queue,
-                 batch_size: int = 8, timeout_ms: float = 5.0,
+                 batch_size: Optional[int] = None,
+                 timeout_ms: Optional[float] = None,
                  input_fn: Callable = _default_input_fn,
                  output_fn: Callable = _default_output_fn,
                  top_n: Optional[int] = None,
                  timer: Optional[Timer] = None,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: Optional[int] = None,
+                 pipelined: Optional[bool] = None,
+                 min_timeout_ms: Optional[float] = None,
+                 max_batch_size: Optional[int] = None):
+        cfg = get_config()
+        if batch_size is None:
+            batch_size = int(cfg.get("zoo.serving.batch_size", 8))
+        if timeout_ms is None:
+            timeout_ms = float(cfg.get("zoo.serving.batch_timeout_ms", 5))
+        if min_timeout_ms is None:
+            min_timeout_ms = float(
+                cfg.get("zoo.serving.batch_timeout_min_ms", 1.0))
+        if max_batch_size is None:
+            max_batch_size = int(cfg.get("zoo.serving.batch_max_size", 0))
+        if pipeline_depth is None:
+            pipeline_depth = int(cfg.get("zoo.serving.pipeline.depth", 2))
+        if pipelined is None:
+            pipelined = bool(cfg.get("zoo.serving.pipeline.enabled", True))
         self.model = model
         self._in = getattr(input_queue, "queue", input_queue)
         self._out_q = output_queue
-        self.batcher = MicroBatcher(self._in, batch_size=batch_size,
-                                    timeout_ms=timeout_ms)
+        self.pipelined = bool(pipelined)
+        if self.pipelined:
+            self.batcher = AdaptiveBatcher(
+                self._in, batch_size=batch_size, timeout_ms=timeout_ms,
+                min_timeout_ms=min_timeout_ms,
+                max_batch_size=max_batch_size or None)
+        else:
+            # the escape hatch restores the WHOLE pre-pipeline engine,
+            # fixed size/timeout batching included -- an operator
+            # disabling the pipeline gets the proven old path, not a
+            # half-new one
+            self.batcher = MicroBatcher(self._in, batch_size=batch_size,
+                                        timeout_ms=timeout_ms)
         self.input_fn = input_fn
         self.output_fn = output_fn
         self.top_n = top_n
@@ -195,30 +262,23 @@ class ServingWorker:
         # overlaps batch n's device compute + result fetch; 1 disables
         self.pipeline_depth = max(1, int(pipeline_depth))
         self._inflight: collections.deque = collections.deque()
+        # live handle on the pipelined engine's in-flight window (for
+        # metrics); set for the duration of a pipelined run
+        self._inflight_q: Optional[_pyqueue.Queue] = None
 
-    # ------------------------------------------------------------ loop --
+    # ------------------------------------------------- synchronous loop --
     def process_one_batch(self, wait_timeout: float = 1.0) -> int:
-        """One pull→predict→push cycle; returns requests served."""
+        """One pull->predict->push cycle (the synchronous engine);
+        returns requests served."""
         with self.timer.timing("batch_wait"):
             blobs = self.batcher.next_batch(wait_timeout=wait_timeout)
-        self._batch_t0 = time.perf_counter()
         if not blobs:
             n = 0
             while self._inflight:  # idle: drain pipelined batches
                 n += self._finalize_one()
             self.served += n
             return n
-        with self.timer.timing("decode", batch=len(blobs)):
-            items: List[Tuple[str, Dict[str, np.ndarray],
-                              Optional[str]]] = []
-            for b in blobs:
-                try:
-                    items.append(_decode_full(b))
-                except Exception as e:  # malformed blob: drop, keep serving
-                    logger.exception("serving: undecodable request "
-                                     "dropped: %s", e)
-            items, bad_images = decode_image_batch(items)
-        decode_s = time.perf_counter() - self._batch_t0
+        items, bad_images, decode_s = self._decode_stage(blobs)
         n_failed = 0
         for uri, reply, msg in bad_images:
             logger.warning("serving: %s", msg)
@@ -247,6 +307,29 @@ class ServingWorker:
         self.served += n
         return n
 
+    # ------------------------------------------------------- stages -----
+    def _decode_stage(self, blobs) -> Tuple[List, List, float]:
+        """npz-decode a pulled micro-batch, then image-decode through
+        the shared thread pool. Returns (items, image_failures,
+        decode_seconds)."""
+        t0 = time.perf_counter()
+        with self.timer.timing("decode", batch=len(blobs)):
+            items: List[Tuple[str, Dict[str, np.ndarray],
+                              Optional[str]]]
+            try:  # fast path: no per-item try frames on clean batches
+                items = [_decode_full(b) for b in blobs]
+            except Exception:
+                items = []
+                for b in blobs:
+                    try:
+                        items.append(_decode_full(b))
+                    except Exception as e:  # malformed blob: drop,
+                        logger.exception(   # keep serving
+                            "serving: undecodable request dropped: %s",
+                            e)
+            items, bad_images = decode_image_batch(items)
+        return items, bad_images, time.perf_counter() - t0
+
     @staticmethod
     def _group_compatible(items):
         """Group requests whose tensors share keys+shapes+dtypes so they
@@ -259,7 +342,13 @@ class ServingWorker:
             groups.setdefault(sig, []).append((uri, tensors, reply))
         return list(groups.values())
 
-    def _predict_group(self, group) -> int:
+    def _dispatch_group(self, group):
+        """Assembly stage for one signature group: stack the requests
+        into a device batch and dispatch it (non-blocking when the
+        model exposes ``predict_async``). Returns an in-flight record
+        -- (``_BATCH``, ...) awaiting finalize, or (``_ERRORS``, ...)
+        when dispatch failed. Stack/input_fn exceptions propagate (the
+        caller owns the per-request error mapping for those)."""
         uris = [u for u, _, _ in group]
         replies = [r for _, _, r in group]
         t0 = time.perf_counter()  # this group's own prep starts here
@@ -277,9 +366,8 @@ class ServingWorker:
                     preds, n = self.model.predict(x), len(group)
         except Exception as e:  # push per-request errors, keep serving
             logger.exception("serving predict failed: %s", e)
-            for uri, reply in zip(uris, replies):
-                self._push_error(uri, reply, str(e))
-            return len(group)
+            return (_ERRORS, [(u, r, str(e))
+                              for u, r in zip(uris, replies)])
         # start the device->host result copy NOW: by finalize time
         # (pipeline_depth batches later) the bytes are already host-
         # side. A synchronous fetch costs a full round trip per batch
@@ -299,21 +387,42 @@ class ServingWorker:
         # can exclude pipeline residency while other batches finalize)
         prep_s = (getattr(self, "_decode_per_item", 0.0) * len(group)
                   + time.perf_counter() - t0)
-        self._inflight.append((uris, replies, preds, n, prep_s))
+        return (_BATCH, uris, replies, preds, n, prep_s)
+
+    def _predict_group(self, group) -> int:
+        rec = self._dispatch_group(group)
+        if rec[0] == _ERRORS:
+            for uri, reply, msg in rec[1]:
+                self._push_error(uri, reply, msg)
+            return len(rec[1])
+        self._inflight.append(rec)
         return 0  # counted when finalized
 
     def _finalize_one(self) -> int:
         """Materialize the oldest in-flight batch and push its results
-        (async dispatch errors surface here). Never raises: push-path
-        failures (broker down, spool disk full) must not kill the
-        serving loop -- callers sit outside the batch guard."""
-        uris, replies, preds, n, prep_s = self._inflight.popleft()
+        (async dispatch errors surface here)."""
+        return self._finalize_record(self._inflight.popleft())
+
+    def _finalize_record(self, rec) -> int:
+        """Finalize stage for one in-flight record. Never raises:
+        push-path failures (broker down, spool disk full) must not kill
+        the serving loop -- callers sit outside the batch guard."""
+        if rec[0] == _ERRORS:
+            try:
+                for uri, reply, msg in rec[1]:
+                    self._push_error(uri, reply, msg)
+            except Exception as e:  # push path down (broker gone):
+                logger.exception(   # the contract still holds
+                    "serving error-push failed (%d error replies "
+                    "lost): %s", len(rec[1]), e)
+            return len(rec[1])
+        _, uris, replies, preds, n, prep_s = rec
         t0 = time.perf_counter()
         try:
             served = self._finalize_inner(uris, replies, preds, n)
             # worker-side service time for this batch: its own decode/
             # stack/dispatch prep + its remaining result wait + push.
-            # Residency in the in-flight deque while OTHER batches
+            # Residency in the in-flight window while OTHER batches
             # finalize is excluded -- which also means device compute
             # that OVERLAPPED that residency doesn't show up here; this
             # is "host work + un-overlapped device wait", the marginal
@@ -340,8 +449,31 @@ class ServingWorker:
                 self._push_error(uri, reply, str(e))
             return len(uris)
         with self.timer.timing("postprocess", batch=len(uris)):
+            # hot path: the common single-ndarray output with default
+            # hooks slices rows directly -- per-request jax tree_map
+            # costs ~10 us each, which dominates postprocess at large
+            # adaptive batches
+            fast = (self.top_n is None
+                    and self.output_fn is _default_output_fn
+                    and isinstance(preds, np.ndarray))
+            backend = getattr(self._out_q, "queue", self._out_q)
+            if (fast and not any(replies)
+                    and hasattr(backend, "put_many")):
+                # one batched push: per-item lock/notify trips cost
+                # more than the encode itself at adaptive batch sizes
+                blobs = [_encode(uri, {"output": preds[i]})
+                         for i, uri in enumerate(uris)]
+                accepted = backend.put_many(blobs)
+                if accepted < len(blobs):
+                    logger.warning(
+                        "output queue full: dropped %d results",
+                        len(blobs) - accepted)
+                return len(uris)
             for i, (uri, reply) in enumerate(zip(uris, replies)):
                 try:
+                    if fast:
+                        self._push(uri, reply, {"output": preds[i]})
+                        continue
                     pred_i = _tree_index(preds, i)
                     if self.top_n is not None:
                         pred_i = _top_n(np.asarray(pred_i), self.top_n)
@@ -354,35 +486,136 @@ class ServingWorker:
                     self._push_error(uri, reply, str(e))
         return len(uris)
 
-    def _push(self, uri: str, reply: Optional[str],
-              tensors: Dict[str, np.ndarray]) -> None:
-        backend = self._reply_backend(reply)
-        if not backend.put(_encode(uri, tensors)):
-            logger.warning("output queue full: dropping result for %s",
-                           uri)
+    # ---------------------------------------------- pipelined engine ----
+    def _run_pipelined(self, max_batches: Optional[int],
+                       wait_timeout: float) -> int:
+        """The staged engine: decode thread -> assembly/dispatch (this
+        thread) -> finalize thread, bounded by ``pipeline_depth``
+        dispatched batches in flight. A bounded run returns only after
+        every request it pulled is answered."""
+        decoded_q: _pyqueue.Queue = _pyqueue.Queue(
+            maxsize=max(2, self.pipeline_depth))
+        inflight_q: _pyqueue.Queue = _pyqueue.Queue(
+            maxsize=self.pipeline_depth)
+        abort = threading.Event()  # abnormal driver exit: unstick stages
+        served_box = [0]
 
-    def _reply_backend(self, reply_to: Optional[str]):
-        """Default output backend, or the named stream on the same TCP
-        broker when the request carried a reply-to (several frontends
-        sharing one broker each get their own results back)."""
-        default = getattr(self._out_q, "queue", self._out_q)
-        if not reply_to or not isinstance(default, TcpQueue):
-            return default
-        if reply_to not in self._reply_queues:
-            self._reply_queues[reply_to] = TcpQueue(
-                f"tcp://{default._host}:{default._port}", name=reply_to)
-        return self._reply_queues[reply_to]
+        def put_stage(q, item) -> bool:
+            while True:
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _pyqueue.Full:
+                    if abort.is_set():
+                        return False
 
-    def _push_error(self, uri: str, reply: Optional[str],
-                    message: str) -> None:
-        # reserved out-of-band key (the "__uri__" convention of
-        # queues._encode) so model outputs named "error" stay usable
-        self._push(uri, reply, {ERROR_KEY: np.asarray(message)})
+        def decode_loop():
+            pulled = 0
+            try:
+                while not self._stop.is_set() and not abort.is_set():
+                    if max_batches is not None and pulled >= max_batches:
+                        break
+                    pulled += 1
+                    with self.timer.timing("batch_wait"):
+                        blobs = self.batcher.next_batch(
+                            wait_timeout=wait_timeout)
+                    if not blobs:
+                        continue
+                    # depth the batcher already observed for policy --
+                    # a second len() here would cost one more broker
+                    # RPC per pull on TcpQueue backends
+                    depth = getattr(self.batcher, "last_depth", -1)
+                    if depth >= 0:
+                        self.timer.gauge("queue_depth", depth)
+                    self.timer.gauge("batch_occupancy", len(blobs))
+                    if not put_stage(decoded_q,
+                                     self._decode_stage(blobs)):
+                        logger.warning(
+                            "serving pipeline aborted with %d decoded "
+                            "requests undispatched", len(blobs))
+                        return
+            except Exception as e:  # batcher/queue failures must
+                logger.exception(   # still close the pipeline cleanly
+                    "serving decode stage failed: %s", e)
+            finally:
+                put_stage(decoded_q, _SENTINEL)
 
+        def finalize_loop():
+            while True:
+                rec = inflight_q.get()
+                if rec is _SENTINEL:
+                    return
+                try:
+                    n = self._finalize_record(rec)
+                except Exception as e:  # belt-and-braces: this thread
+                    # must never die -- the driver blocks on the
+                    # bounded FIFO it drains, so a dead finalizer
+                    # wedges the whole engine
+                    logger.exception("serving finalize stage "
+                                     "failed: %s", e)
+                    n = len(rec[1])
+                served_box[0] += n
+                self.served += n
+
+        decode_t = threading.Thread(target=decode_loop, daemon=True,
+                                    name="serving-decode")
+        finalize_t = threading.Thread(target=finalize_loop, daemon=True,
+                                      name="serving-finalize")
+        self._inflight_q = inflight_q
+        decode_t.start()
+        finalize_t.start()
+        try:
+            while True:
+                with self.timer.timing("assembly_wait"):
+                    item = decoded_q.get()
+                if item is _SENTINEL:
+                    break
+                items, bad_images, decode_s = item
+                if bad_images:
+                    for uri, reply, msg in bad_images:
+                        logger.warning("serving: %s", msg)
+                    # errors ride the in-flight FIFO: responses keep
+                    # arrival order and finalize owns the counters
+                    inflight_q.put((_ERRORS, list(bad_images)))
+                if not items:
+                    continue
+                self._decode_per_item = decode_s / max(1, len(items))
+                for group in self._group_compatible(items):
+                    try:
+                        rec = self._dispatch_group(group)
+                    except Exception as e:  # input_fn bugs etc.
+                        logger.exception("serving batch failed: %s", e)
+                        rec = (_ERRORS, [(u, r, str(e))
+                                         for u, _, r in group])
+                    with self.timer.timing("inflight_wait"):
+                        inflight_q.put(rec)  # blocks at the window cap
+                    self.timer.gauge("inflight", inflight_q.qsize())
+        finally:
+            abort.set()
+            dropped = 0
+            while True:  # abnormal exit: unstick + account a blocked
+                try:     # decode stage (normal exit finds it empty)
+                    item = decoded_q.get_nowait()
+                    if item is not _SENTINEL:
+                        dropped += len(item[0]) + len(item[1])
+                except _pyqueue.Empty:
+                    break
+            if dropped:
+                logger.warning("serving pipeline dropped %d decoded "
+                               "requests on abnormal exit", dropped)
+            inflight_q.put(_SENTINEL)
+            finalize_t.join()
+            decode_t.join(timeout=5.0)
+            self._inflight_q = None
+        return served_box[0]
+
+    # ------------------------------------------------------- lifecycle --
     def run(self, max_batches: Optional[int] = None,
             wait_timeout: float = 0.05) -> int:
-        """Serve until stopped (or ``max_batches`` cycles); returns total
-        requests served in this call."""
+        """Serve until stopped (or ``max_batches`` pull cycles); returns
+        total requests served in this call."""
+        if self.pipelined:
+            return self._run_pipelined(max_batches, wait_timeout)
         total = 0
         batches = 0
         while not self._stop.is_set():
@@ -415,9 +648,10 @@ class ServingWorker:
             thread.join(join_timeout)
             if thread.is_alive():
                 # the worker thread is still draining (e.g. a slow
-                # first compile); it owns _inflight -- draining here
-                # would race its popleft. KEEP the handle so a retried
-                # stop() (or start()) still sees the live thread.
+                # first compile); it owns the in-flight window --
+                # draining here would race its pops. KEEP the handle so
+                # a retried stop() (or start()) still sees the live
+                # thread.
                 logger.warning("serving worker still busy after %.1fs; "
                                "in-flight batches drain on its thread",
                                join_timeout)
@@ -426,8 +660,49 @@ class ServingWorker:
         while self._inflight:  # flush: accepted requests must answer
             self.served += self._finalize_one()
 
+    # --------------------------------------------------------- outputs --
+    def _push(self, uri: str, reply: Optional[str],
+              tensors: Dict[str, np.ndarray]) -> None:
+        backend = self._reply_backend(reply)
+        if not backend.put(_encode(uri, tensors)):
+            logger.warning("output queue full: dropping result for %s",
+                           uri)
+
+    def _reply_backend(self, reply_to: Optional[str]):
+        """Default output backend, or the named stream on the same TCP
+        broker when the request carried a reply-to (several frontends
+        sharing one broker each get their own results back)."""
+        default = getattr(self._out_q, "queue", self._out_q)
+        if not reply_to or not isinstance(default, TcpQueue):
+            return default
+        if reply_to not in self._reply_queues:
+            self._reply_queues[reply_to] = TcpQueue(
+                f"tcp://{default._host}:{default._port}", name=reply_to)
+        return self._reply_queues[reply_to]
+
+    def _push_error(self, uri: str, reply: Optional[str],
+                    message: str) -> None:
+        # reserved out-of-band key (the "__uri__" convention of
+        # queues._encode) so model outputs named "error" stay usable
+        self._push(uri, reply, {ERROR_KEY: np.asarray(message)})
+
+    # --------------------------------------------------------- metrics --
     def metrics(self) -> Dict[str, Any]:
-        return {"served": self.served, "stages": self.timer.summary()}
+        inflight_q = self._inflight_q  # read once: the worker thread
+        # clears this attribute when a pipelined run exits
+        pipe: Dict[str, Any] = {
+            "enabled": self.pipelined,
+            "depth": self.pipeline_depth,
+            "inflight": (inflight_q.qsize() if inflight_q is not None
+                         else len(self._inflight)),
+            "batcher": self.batcher.stats(),
+        }
+        try:
+            pipe["queue_depth"] = len(self._in)
+        except Exception:
+            pass
+        return {"served": self.served, "stages": self.timer.summary(),
+                "pipeline": pipe}
 
 
 def _tree_index(preds, i: int):

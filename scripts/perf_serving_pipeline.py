@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""A/B harness: synchronous vs pipelined serving engine (ISSUE-1).
+
+Two phases, both driving the REAL data plane (InputQueue -> worker ->
+OutputQueue, fast wire codec, InferenceModel bucketed predict):
+
+1. **Saturation throughput**: pre-fill the input queue with N requests
+   (the reference's offline-benchmark pattern: docker/cluster-serving/
+   perf/offline-benchmark) and time until every result lands. Windows
+   interleave sync/pipelined so a machine-speed shift hits both
+   engines, and the best window per engine is the comparator (the
+   repo's chip-variance convention, BENCH_NOTES.md).
+2. **Matched-load latency**: offer BOTH engines the same paced request
+   rate (well under the sync engine's saturation point) in closed loop
+   and compare client-observed p50/p99. The pipelined engine must be
+   no worse -- its adaptive deadline should actually *win* here, since
+   a shallow queue tightens the linger instead of burning the fixed
+   timeout.
+
+Both engines run the same configured ``batch_size``/``timeout_ms``;
+the pipelined engine additionally gets what the new data plane always
+gives it: staged decode/assembly/finalize threads, a bounded in-flight
+dispatch window, and the adaptive batcher (backlog growth snapped to
+the warmed bucket ladder). On this 1-core CPU-backend rig the win is
+dominated by adaptive batch growth amortizing per-batch dispatch
+overhead (stage overlap cannot add cores); on multi-core or TPU hosts
+the decode/compute/finalize overlap stacks on top.
+
+Prints one JSON line:
+  {"sync_rps", "pipe_rps", "speedup", "sync_p50_ms", "sync_p99_ms",
+   "pipe_p50_ms", "pipe_p99_ms", "matched_rps", ...}
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+BATCH_SIZE = 8          # the stock serving default (zoo.serving.batch_size)
+TIMEOUT_MS = 5.0        # stock linger (zoo.serving.batch_timeout_ms)
+MAX_BATCH = 256         # adaptive growth ceiling (bucket ladder value)
+PIPE_DEPTH = 3
+FEATURES = 64
+HIDDEN = 256
+
+
+def build_model():
+    import flax.linen as nn
+    import jax
+
+    from analytics_zoo_tpu.inference.inference_model import (
+        InferenceModel, bucket_ladder)
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(HIDDEN)(x))
+            x = nn.relu(nn.Dense(HIDDEN)(x))
+            return nn.Dense(16)(x)
+
+    net = Net()
+    variables = net.init(jax.random.PRNGKey(0),
+                         np.zeros((1, FEATURES), np.float32))
+    model = InferenceModel().load_flax(net, variables=variables)
+    # warm every ladder bucket up to the adaptive ceiling: the A/B
+    # times serving, not XLA compiles
+    model.warm_up(np.zeros((1, FEATURES), np.float32),
+                  batch_sizes=tuple(bucket_ladder(MAX_BATCH)))
+    return model
+
+
+def _worker(model, in_q, out_q, pipelined):
+    from analytics_zoo_tpu.serving.worker import ServingWorker
+
+    return ServingWorker(model, in_q, out_q, batch_size=BATCH_SIZE,
+                         timeout_ms=TIMEOUT_MS, pipelined=pipelined,
+                         max_batch_size=MAX_BATCH,
+                         pipeline_depth=PIPE_DEPTH)
+
+
+def saturation_window(model, pipelined, n, xs):
+    """Pre-filled queue -> time to drain everything; returns (rps,
+    worker_metrics). The client side counts raw result blobs (one
+    get_many per poll) instead of tensor-decoding all of them: on this
+    1-core rig a full client decode costs ~10 us/request of the same
+    CPU the engine under test needs, which would understate BOTH
+    engines and dilute their ratio. A 64-result sample is still
+    decoded and validated per window."""
+    from analytics_zoo_tpu.serving.queues import (
+        InputQueue, OutputQueue, _decode)
+
+    in_q, out_q = InputQueue(maxlen=n + 10), OutputQueue()
+    for i in range(n):
+        assert in_q.enqueue(f"r{i}", x=xs[i % len(xs)])
+    worker = _worker(model, in_q, out_q, pipelined)
+    backend = out_q.queue
+    sample = []
+    t0 = time.perf_counter()
+    worker.start()
+    done = 0
+    while done < n:
+        got = backend.get_many(512)
+        done += len(got)
+        if not sample and got:
+            sample = got[:64]
+        if not got:
+            time.sleep(0.002)
+    dt = time.perf_counter() - t0
+    worker.stop()
+    for blob in sample:  # spot-check real responses came back
+        uri, tensors = _decode(blob)
+        assert uri.startswith("r") and "output" in tensors, uri
+    return n / dt, worker.metrics()
+
+
+def matched_load_window(model, pipelined, rps, seconds, xs):
+    """Paced open-loop offered load; returns (p50_s, p99_s,
+    achieved_rps). Latency is client-observed enqueue->dequeue."""
+    from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
+
+    in_q, out_q = InputQueue(maxlen=100000), OutputQueue()
+    worker = _worker(model, in_q, out_q, pipelined).start()
+    try:
+        # pre-burst: let the engine's threads/buckets reach steady
+        # state so the window measures serving, not spin-up
+        for i in range(200):
+            in_q.enqueue(f"warm{i}", x=xs[i % len(xs)])
+        drained = 0
+        deadline = time.perf_counter() + 10.0
+        while drained < 200 and time.perf_counter() < deadline:
+            drained += len(out_q.dequeue_all())
+            time.sleep(0.001)
+        sent = {}
+        done = {}
+        t_start = time.perf_counter()
+        t_end = t_start + seconds
+        i = 0
+        while True:
+            now = time.perf_counter()
+            if now >= t_end:
+                break
+            # pace: how many requests the schedule owes by `now`
+            owed = int((now - t_start) * rps) - i
+            for _ in range(max(0, owed)):
+                uri = f"m{i}"
+                in_q.enqueue(uri, x=xs[i % len(xs)])
+                sent[uri] = time.perf_counter()
+                i += 1
+            for uri, _t in out_q.dequeue_all():
+                done[uri] = time.perf_counter()
+            time.sleep(0.0005)
+        deadline = time.perf_counter() + 10.0
+        while len(done) < len(sent) and time.perf_counter() < deadline:
+            for uri, _t in out_q.dequeue_all():
+                done[uri] = time.perf_counter()
+            time.sleep(0.001)
+    finally:
+        worker.stop()
+    lats = sorted(done[u] - sent[u] for u in done if u in sent)
+    if not lats:
+        raise RuntimeError("matched-load window produced no results")
+    p50 = lats[len(lats) // 2]
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+    return p50, p99, len(done) / seconds
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=6000,
+                    help="requests per saturation window")
+    ap.add_argument("--windows", type=int, default=3,
+                    help="interleaved saturation windows per engine")
+    ap.add_argument("--matched-rps", type=float, default=2000.0,
+                    help="offered load for the latency phase")
+    ap.add_argument("--matched-seconds", type=float, default=5.0)
+    args = ap.parse_args()
+
+    model = build_model()
+    rng = np.random.RandomState(0)
+    xs = rng.randn(1024, FEATURES).astype(np.float32)
+
+    # one throwaway window per engine: first-run thread/alloc warmup
+    saturation_window(model, False, 500, xs)
+    saturation_window(model, True, 500, xs)
+
+    sync_rps, pipe_rps = [], []
+    pipe_metrics = None
+    for _ in range(args.windows):  # interleaved: shifts hit both
+        r, _ = saturation_window(model, False, args.requests, xs)
+        sync_rps.append(r)
+        r, pipe_metrics = saturation_window(model, True, args.requests,
+                                            xs)
+        pipe_rps.append(r)
+
+    best_sync, best_pipe = max(sync_rps), max(pipe_rps)
+    sync_p50, sync_p99, sync_ach = matched_load_window(
+        model, False, args.matched_rps, args.matched_seconds, xs)
+    pipe_p50, pipe_p99, pipe_ach = matched_load_window(
+        model, True, args.matched_rps, args.matched_seconds, xs)
+
+    batcher = (pipe_metrics or {}).get("pipeline", {}).get("batcher", {})
+    line = {
+        "sync_rps": round(best_sync, 1),
+        "pipe_rps": round(best_pipe, 1),
+        "speedup": round(best_pipe / best_sync, 3),
+        "sync_rps_all": [round(r, 1) for r in sync_rps],
+        "pipe_rps_all": [round(r, 1) for r in pipe_rps],
+        "matched_rps": args.matched_rps,
+        "sync_p50_ms": round(sync_p50 * 1e3, 2),
+        "sync_p99_ms": round(sync_p99 * 1e3, 2),
+        "pipe_p50_ms": round(pipe_p50 * 1e3, 2),
+        "pipe_p99_ms": round(pipe_p99 * 1e3, 2),
+        "sync_achieved_rps": round(sync_ach, 1),
+        "pipe_achieved_rps": round(pipe_ach, 1),
+        "batch_size": BATCH_SIZE,
+        "max_batch_size": MAX_BATCH,
+        "pipe_mean_occupancy": round(batcher.get("mean_occupancy", 0),
+                                     1),
+        "requests_per_window": args.requests,
+        "cores": os.cpu_count(),
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,10 @@ class TestInferenceModel:
         assert np.max(np.abs(out - ref)) / denom < 0.05
 
     def test_encrypted_roundtrip(self, tmp_path):
+        from analytics_zoo_tpu.inference.encrypt import crypto_available
+
+        if not crypto_available():
+            pytest.skip("cryptography package not installed")
         m, path, x = trained_zoo_model(tmp_path)
         enc_dir = str(tmp_path / "enc")
         InferenceModel.save_encrypted(path + "/weights", enc_dir,

@@ -135,6 +135,38 @@ class TestWireFormat:
         assert out["img"].dtype == np.uint8
         assert out["img"].tobytes() == jpeg
 
+    def test_empty_column_raises_naming_the_column(self):
+        """A rowless Arrow column must fail with a clear error naming
+        the column, not an IndexError (ISSUE-1 satellite)."""
+        field = pa.field("imgcol", pa.string())
+        arr = pa.array([], type=pa.string())
+        sink = pa.BufferOutputStream()
+        batch = pa.RecordBatch.from_arrays(
+            [arr], schema=pa.schema([field]))
+        with pa.RecordBatchStreamWriter(sink, batch.schema) as w:
+            w.write_batch(batch)
+        with pytest.raises(ValueError, match="imgcol"):
+            decode_arrow_payload(
+                base64.b64encode(sink.getvalue().to_pybytes()))
+
+    def test_multi_row_string_column_decodes_all_rows(self):
+        """Payloads chunked across several string rows must decode and
+        reassemble (previously only row 0 was decoded)."""
+        jpeg = b"\xff\xd8\xff\xe0" + bytes(range(64)) * 4
+        half = len(jpeg) // 2
+        rows = [base64.b64encode(jpeg[:half]).decode(),
+                base64.b64encode(jpeg[half:]).decode()]
+        field = pa.field("img", pa.string())
+        arr = pa.array(rows)
+        sink = pa.BufferOutputStream()
+        batch = pa.RecordBatch.from_arrays(
+            [arr], schema=pa.schema([field]))
+        with pa.RecordBatchStreamWriter(sink, batch.schema) as w:
+            w.write_batch(batch)
+        out = decode_arrow_payload(
+            base64.b64encode(sink.getvalue().to_pybytes()))
+        assert out["img"].tobytes() == jpeg
+
     def test_result_value_json(self):
         single = encode_result_value({"output": np.asarray([1.0, 2.0])})
         assert json.loads(single) == [1.0, 2.0]
@@ -192,6 +224,53 @@ class TestRespServer:
         assert json.loads(res[b"value"]) == [0.25, 0.75]
         assert cli.cmd("DEL", key) == 1
         assert cli.cmd("KEYS", RESULT_PREFIX + "*") == []
+
+    def test_concurrent_xgroup_create_one_ok_one_busygroup(self):
+        """N clients racing XGROUP CREATE on the same group: exactly
+        one +OK, the rest BUSYGROUP (the check+add is now locked)."""
+        import threading
+
+        in_q, out_q = InputQueue(), OutputQueue()
+        fe = RedisFrontend(in_q, out_q, port=0).serve()
+        try:
+            n = 8
+            replies, lock = [], threading.Lock()
+            start = threading.Barrier(n)
+
+            def create():
+                cli = RespClient(fe.host, fe.port)
+                start.wait()
+                try:
+                    r = cli.cmd("XGROUP", "CREATE", "serving_stream",
+                                "racing")
+                except AssertionError as e:
+                    r = str(e)
+                with lock:
+                    replies.append(r)
+
+            threads = [threading.Thread(target=create)
+                       for _ in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10.0)
+            assert replies.count("OK") == 1, replies
+            assert sum("BUSYGROUP" in str(r)
+                       for r in replies) == n - 1, replies
+        finally:
+            fe.stop()
+
+    def test_blank_line_flood_does_not_recurse(self, adapter):
+        """Thousands of bare CRLFs before a command used to recurse
+        once per line (RecursionError killed the connection thread);
+        the loop-based parser must survive and answer."""
+        fe, in_q, out_q = adapter
+        cli = RespClient(fe.host, fe.port)
+        cli.sock.sendall(b"\r\n" * 5000)
+        assert cli.cmd("PING") == "PONG"
+        # inline (non-array) commands still parse after the flood
+        cli.sock.sendall(b"\r\n\r\nPING\r\n")
+        assert cli._reply() == "PONG"
 
     def test_idle_connection_does_not_block_stop(self):
         in_q, out_q = InputQueue(), OutputQueue()
