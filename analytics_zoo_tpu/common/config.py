@@ -74,6 +74,14 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.serving.pipeline.enabled": True,
     "zoo.serving.pipeline.depth": 2,
     "zoo.serving.http_port": 10020,
+    # observability (analytics_zoo_tpu.obs): per-request tracing gate
+    # (spans ride queue blobs as __trace__ and export as Chrome trace
+    # JSON; off by default -- the disabled path must cost nothing),
+    # span ring size, and the background rollup reporter cadence in
+    # seconds (0 disables the thread)
+    "zoo.obs.trace.enabled": False,
+    "zoo.obs.trace.max_spans": 8192,
+    "zoo.obs.report.interval": 0.0,
     # inference
     "zoo.inference.default_dtype": "bfloat16",
     # XLA persistent compilation cache (see common.context.

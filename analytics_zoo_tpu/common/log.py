@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from analytics_zoo_tpu.obs.metrics import StatCore
+
 _LOG_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 _configured = False
 _lock = threading.Lock()
@@ -36,34 +38,42 @@ def get_logger(name: str = "analytics_zoo_tpu") -> logging.Logger:
 
 
 class TimerStat:
-    """Accumulated stats for one named stage (count/total/avg/max/min/top-k)."""
+    """Accumulated stats for one named stage (count/total/avg/max/min/
+    top-k) -- a thin shim over :class:`analytics_zoo_tpu.obs.metrics.
+    StatCore`, the single stat-math implementation shared with the
+    serving Timer and the registry histograms (ISSUE-2 dedup)."""
 
-    __slots__ = ("name", "count", "total", "max", "min", "_topk", "_k")
+    __slots__ = ("name", "_core")
 
     def __init__(self, name: str, k: int = 10):
         self.name = name
-        self.count = 0
-        self.total = 0.0
-        self.max = 0.0
-        self.min = float("inf")
-        self._topk: List[float] = []
-        self._k = k
+        self._core = StatCore(top_k=k)
 
     def record(self, elapsed: float) -> None:
-        self.count += 1
-        self.total += elapsed
-        self.max = max(self.max, elapsed)
-        self.min = min(self.min, elapsed)
-        self._topk.append(elapsed)
-        self._topk.sort(reverse=True)
-        del self._topk[self._k:]
+        self._core.observe(elapsed)
+
+    @property
+    def count(self) -> int:
+        return self._core.count
+
+    @property
+    def total(self) -> float:
+        return self._core.total
+
+    @property
+    def max(self) -> float:
+        return self._core.max
+
+    @property
+    def min(self) -> float:
+        return self._core.min
 
     @property
     def avg(self) -> float:
-        return self.total / self.count if self.count else 0.0
+        return self._core.avg
 
     def top(self, n: int = 10) -> List[float]:
-        return self._topk[:n]
+        return self._core.top(n)
 
     def summary(self) -> str:
         return (
@@ -74,11 +84,15 @@ class TimerStat:
 
 
 class Timer:
-    """Named-stage timer registry; thread-safe."""
+    """Named-stage timer registry; thread-safe. ``mirror`` (an obs
+    registry histogram family labelled by ``stage``) additionally
+    publishes every recorded duration process-wide -- how training
+    stage timers join the same ``/metrics`` scrape as serving."""
 
-    def __init__(self):
+    def __init__(self, mirror=None):
         self._stats: Dict[str, TimerStat] = {}
         self._lock = threading.Lock()
+        self._mirror = mirror
 
     @contextlib.contextmanager
     def timing(self, name: str, log: Optional[logging.Logger] = None):
@@ -90,6 +104,8 @@ class Timer:
             with self._lock:
                 stat = self._stats.setdefault(name, TimerStat(name))
                 stat.record(elapsed)
+            if self._mirror is not None:
+                self._mirror.labels(stage=name).observe(elapsed)
             if log is not None:
                 log.info("%s took %.2f ms", name, elapsed * 1e3)
 

@@ -27,8 +27,26 @@ import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.obs.metrics import get_registry
 
 logger = get_logger(__name__)
+
+# inference engine wiring into the unified registry: live XLA compiles
+# (should stay flat after warm-up -- a climbing counter means requests
+# are paying compile stalls), dispatch volume, and how much of each
+# device batch is bucket padding (wasted compute; high ratios mean the
+# batcher's caps sit badly against the bucket ladder)
+_REG = get_registry()
+_M_COMPILES = _REG.counter(
+    "zoo_inference_compile_total",
+    "XLA shape-bucket compiles (flat after warm-up in a healthy "
+    "deployment; climbing means requests pay compile stalls)")
+_M_DISPATCH = _REG.counter(
+    "zoo_inference_dispatch_total", "Prediction batches dispatched")
+_M_PAD = _REG.histogram(
+    "zoo_inference_batch_pad_ratio",
+    "Fraction of each dispatched device batch that is bucket padding",
+    buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
 
 
 def _bucket(n: int) -> int:
@@ -303,5 +321,8 @@ class InferenceModel:
             if fn is None:
                 fn = jax.jit(self._apply_fn)
                 self._compiled[key] = fn
+                _M_COMPILES.inc()
                 logger.info("inference: compiling bucket %s", key)
+        _M_DISPATCH.inc()
+        _M_PAD.observe((bucket - n) / bucket)
         return fn(self.variables, padded), n

@@ -35,11 +35,21 @@ from analytics_zoo_tpu.learn import checkpoint as ckpt_lib
 from analytics_zoo_tpu.learn.metrics import Metric, resolve_metric
 from analytics_zoo_tpu.learn.objectives import resolve_loss
 from analytics_zoo_tpu.learn.optim import resolve_optimizer
+from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.parallel import sharding
 from analytics_zoo_tpu.parallel.mesh import default_mesh
 from analytics_zoo_tpu.parallel.sharding import replicated
 
 logger = get_logger(__name__)
+
+# training progress in the unified registry (the BigDL ``Metrics``
+# counter role): scraping /metrics on a co-located serving frontend --
+# or reading Reporter rollups -- shows training and serving side by side
+_REG = get_registry()
+_M_STEPS = _REG.counter(
+    "zoo_learn_steps_total", "Optimization steps completed")
+_M_EPOCHS = _REG.counter(
+    "zoo_learn_epochs_total", "Training epochs completed")
 
 
 def training_prng_key(seed: int):
@@ -594,6 +604,7 @@ class Estimator:
                                             x, y, step_rng)
                     self.global_step += 1
                     n_steps += 1
+                    _M_STEPS.inc()
                     if (self.global_step % log_every == 0 or
                             self.global_step == 1):
                         lf = float(loss)
@@ -630,6 +641,7 @@ class Estimator:
                             self.global_step, state.epoch)
                 # epoch completed; ONE host sync for the whole epoch
                 self.epoch += 1
+                _M_EPOCHS.inc()
                 state.epoch = self.epoch
                 entry: Dict[str, float] = {
                     "epoch": self.epoch,
@@ -755,6 +767,8 @@ class Estimator:
                     continue
                 self.epoch += 1
                 self.global_step += n_steps
+                _M_EPOCHS.inc()
+                _M_STEPS.inc(n_steps)
                 entry: Dict[str, float] = {
                     "epoch": self.epoch, "loss": lf,
                     "seconds": time.time() - t0}

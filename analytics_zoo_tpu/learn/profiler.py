@@ -9,7 +9,10 @@ torch_runner.py:308-316). Here training profiling has two layers:
 
 - ``TrainingProfiler``: host-side stage timers (data wait vs step
   dispatch vs epoch wall time) with the same count/avg/max/min summary
-  shape as the serving Timer -- answers "am I input-bound?".
+  shape as the serving Timer -- answers "am I input-bound?". Since
+  ISSUE-2 every stage duration also lands in the process-wide obs
+  registry (``zoo_learn_stage_duration_seconds{stage=...}``), so
+  training and serving share one scrape vocabulary.
 - XLA device tracing: ``jax.profiler`` traces written to a TensorBoard
   -loadable directory when ``trace_dir`` is set -- answers "what is the
   chip doing?" (the reference has no analog; BigDL had no device
@@ -22,13 +25,19 @@ import contextlib
 from typing import Any, Dict, Optional
 
 from analytics_zoo_tpu.common.log import Timer
+from analytics_zoo_tpu.obs.metrics import get_registry
+
+_M_LEARN_STAGE = get_registry().histogram(
+    "zoo_learn_stage_duration_seconds",
+    "Training stage latency (data_wait, train_step, epoch, ...)",
+    labelnames=("stage",))
 
 
 class TrainingProfiler:
     """Stage timers + optional jax.profiler trace for one fit() run."""
 
     def __init__(self, trace_dir: Optional[str] = None):
-        self.timer = Timer()
+        self.timer = Timer(mirror=_M_LEARN_STAGE)
         self.trace_dir = trace_dir
         self._tracing = False
 

@@ -1,0 +1,40 @@
+"""Unified observability layer: metrics registry, Prometheus/JSON
+export, request tracing, and the background rollup reporter.
+
+One vocabulary for serving AND training instrumentation (the reference
+split this between the serving ``Timer``/dashboard publisher and BigDL
+training ``Metrics``): every subsystem registers
+``zoo_<subsystem>_<name>_<unit>`` instruments in the process-wide
+registry; ``HttpFrontend`` exposes it at ``GET /metrics`` (Prometheus
+text) and ``GET /metrics.json``; spans ride requests through the
+serving pipeline and export as Chrome trace-event JSON. See
+docs/observability.md.
+"""
+
+from analytics_zoo_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    METRIC_NAME_RE,
+    MetricsRegistry,
+    StatCore,
+    check_metric_name,
+    get_registry,
+)
+from analytics_zoo_tpu.obs.tracing import (  # noqa: F401
+    Tracer,
+    current_trace_id,
+    get_tracer,
+    maybe_trace,
+    new_trace_id,
+    trace_context,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram",
+    "METRIC_NAME_RE", "MetricsRegistry", "StatCore",
+    "check_metric_name", "get_registry",
+    "Tracer", "current_trace_id", "get_tracer", "maybe_trace",
+    "new_trace_id", "trace_context",
+]

@@ -30,6 +30,15 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from analytics_zoo_tpu.obs.metrics import get_registry
+
+# why a batch closed, process-wide (obs registry): "size" = cap
+# reached, "deadline" = linger expired -- the ratio is the first thing
+# to read when tuning batch_size/timeout_ms against live traffic
+_M_CLOSES = get_registry().counter(
+    "zoo_serving_batch_close_total",
+    "Micro-batches closed, by close reason", labelnames=("reason",))
+
 
 def _bucket(n: int) -> int:
     """Power-of-two bucket ladder (mirrors inference_model._bucket; kept
@@ -65,6 +74,8 @@ class MicroBatcher:
             if item is None:
                 break
             batch.append(item)
+        _M_CLOSES.labels(reason="size" if len(batch) >= self.batch_size
+                         else "deadline").inc()
         return batch
 
     def stats(self) -> Dict[str, Any]:
@@ -164,6 +175,7 @@ class AdaptiveBatcher(MicroBatcher):
                 break
             batch.append(item)
         reason = "size" if len(batch) >= cap else "deadline"
+        _M_CLOSES.labels(reason=reason).inc()
         with self._lock:
             self._closes[reason] += 1
             self._occupancy_sum += len(batch)

@@ -1,4 +1,4 @@
-"""HTTP frontend: /predict + /metrics over the serving queues.
+"""HTTP frontend: /predict + observability routes over the serving queues.
 
 The analog of the akka-http frontend (ref: zoo/.../serving/http/
 FrontEndApp.scala:40-130 -- a /predict route that XADDs the request into
@@ -6,11 +6,19 @@ Redis, awaits the result stream, and a /metrics route exposing timer
 percentiles). Here: a stdlib ``ThreadingHTTPServer``; each /predict POST
 enqueues into the InputQueue with a fresh uri, a router thread drains the
 OutputQueue into per-uri mailboxes, and the handler blocks on its mailbox
-with a deadline. Dependency-free JSON wire format:
+with a deadline. Dependency-free wire format:
 
-  POST /predict  {"inputs": {"x": [[...]]}}            -> {"predictions": ...}
-  POST /predict  {"instances": [{"x": [...]}, ...]}    -> {"predictions": [...]}
-  GET  /metrics                                        -> stage timers + queue depths
+  POST /predict       {"inputs": {"x": [[...]]}}         -> {"predictions": ...}
+  POST /predict       {"instances": [{"x": [...]}, ...]} -> {"predictions": [...]}
+  GET  /metrics       Prometheus text exposition (process registry)
+  GET  /metrics.json  JSON snapshot: registry + frontend/worker summaries
+  GET  /healthz       liveness (200, or 503 when the worker thread died)
+  GET  /trace         Chrome trace-event JSON of collected request spans
+
+Unknown paths get a 404 with a JSON error body. With
+``zoo.obs.trace.enabled`` each /predict carries a fresh trace id through
+the queue blobs, so its worker-side decode/dispatch/finalize spans join
+the frontend's ``http_request`` span under one id (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -25,10 +33,30 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.obs import tracing
+from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.serving.timer import Timer
 from analytics_zoo_tpu.serving.worker import ERROR_KEY
 
 logger = get_logger(__name__)
+
+_REG = get_registry()
+_M_HTTP_STAGE = _REG.histogram(
+    "zoo_http_stage_duration_seconds",
+    "HTTP frontend stage latency (predict_request, ...)",
+    labelnames=("stage",))
+_M_HTTP_REQS = _REG.counter(
+    "zoo_http_requests_total", "HTTP requests served, by route and "
+    "status code", labelnames=("route", "code"))
+_M_HTTP_DROPPED = _REG.counter(
+    "zoo_http_dropped_results_total",
+    "Results dropped for abandoned (timed-out) requests")
+
+# label-cardinality guard: only known routes get their own label value;
+# everything else (scanners probing arbitrary 404 paths) collapses to
+# "other" so client-supplied URLs cannot grow the registry unboundedly
+_KNOWN_ROUTES = frozenset(
+    ("/predict", "/metrics", "/metrics.json", "/healthz", "/trace", "/"))
 
 
 class _ResultRouter:
@@ -66,6 +94,7 @@ class _ResultRouter:
                     self._results[uri] = tensors
                     self._cv.notify_all()
                 else:
+                    _M_HTTP_DROPPED.inc()
                     logger.warning("dropping result for abandoned "
                                    "request %s", uri)
 
@@ -128,35 +157,62 @@ class HttpFrontend:
         self.router = _ResultRouter(output_queue)
         self.worker = worker
         self.request_timeout = request_timeout
-        self.timer = timer or Timer()
+        self.timer = timer or Timer(mirror=_M_HTTP_STAGE)
         self._tls = certfile is not None
+        self._started_at = time.time()
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # route to our logger
                 logger.debug("http: " + fmt, *args)
 
-            def _reply(self, code: int, payload: Any):
-                body = json.dumps(payload).encode()
+            def _reply(self, code: int, payload: Any,
+                       content_type: str = "application/json"):
+                # count BEFORE writing: the increment must be visible
+                # by the time the client has read the response, and a
+                # mid-write disconnect must still count the request
+                route = self.path.split("?")[0]
+                if route not in _KNOWN_ROUTES:
+                    route = "other"
+                _M_HTTP_REQS.labels(route=route, code=str(code)).inc()
+                body = (payload if isinstance(payload, bytes)
+                        else json.dumps(payload).encode())
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/metrics":
+                # dispatch ignores the query string (a scrape config's
+                # params or a cache-buster must not 404 a known route)
+                route = self.path.split("?")[0]
+                if route == "/metrics":
+                    # Prometheus text exposition of the process-wide
+                    # registry (scrape target; format 0.0.4)
+                    self._reply(
+                        200, get_registry().prometheus_text().encode(),
+                        content_type="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                elif route == "/metrics.json":
                     self._reply(200, frontend.metrics())
-                elif self.path == "/":
+                elif route == "/healthz":
+                    code, payload = frontend.health()
+                    self._reply(code, payload)
+                elif route == "/trace":
+                    self._reply(200, tracing.get_tracer().chrome_trace())
+                elif route == "/":
                     # welcome route (ref: FrontEndApp.scala:40)
                     self._reply(200, {"message": "welcome to analytics "
                                                  "zoo tpu serving"})
                 else:
-                    self._reply(404, {"error": "not found"})
+                    self._reply(404, {"error": "not found",
+                                      "path": self.path})
 
             def do_POST(self):
-                if self.path != "/predict":
-                    self._reply(404, {"error": "not found"})
+                if self.path.split("?")[0] != "/predict":
+                    self._reply(404, {"error": "not found",
+                                      "path": self.path})
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
@@ -206,6 +262,19 @@ class HttpFrontend:
 
     # --------------------------------------------------------- requests --
     def handle_predict(self, req: Any):
+        """Predict with optional end-to-end tracing: when
+        ``zoo.obs.trace.enabled``, the whole request runs under a fresh
+        trace id (enqueued blobs carry it to the worker stages), an
+        ``http_request`` span is recorded, and the response echoes the
+        id for client-side correlation."""
+        with tracing.maybe_trace("http_request") as trace_id:
+            code, payload = self._handle_predict(req)
+            if trace_id is not None and isinstance(payload, dict):
+                payload = dict(payload)
+                payload["trace_id"] = trace_id
+            return code, payload
+
+    def _handle_predict(self, req: Any):
         if not isinstance(req, dict):
             return 400, {"error": "body must be a JSON object"}
         if "instances" in req:
@@ -309,6 +378,8 @@ class HttpFrontend:
         self._server.server_close()
 
     def metrics(self) -> Dict[str, Any]:
+        """The JSON snapshot API (``GET /metrics.json``): historical
+        frontend/worker summaries plus the full process registry."""
         out: Dict[str, Any] = {"frontend": self.timer.summary()}
         try:
             out["input_queue_depth"] = len(self._in)
@@ -316,4 +387,21 @@ class HttpFrontend:
             pass
         if self.worker is not None:
             out["worker"] = self.worker.metrics()
+        out["registry"] = get_registry().snapshot()
         return out
+
+    def health(self):
+        """Liveness for ``GET /healthz``: 503 once a started worker's
+        serving thread has died (a stopped or inline-run worker is not
+        a failure -- there is no thread to have died)."""
+        worker = self.worker
+        thread = getattr(worker, "_thread", None)
+        alive = thread is None or thread.is_alive()
+        payload = {
+            "status": "ok" if alive else "worker_dead",
+            "uptime_s": round(time.time() - self._started_at, 3),
+        }
+        if worker is not None:
+            payload["served"] = worker.served
+            payload["pipelined"] = worker.pipelined
+        return (200 if alive else 503), payload

@@ -72,13 +72,14 @@ class ServingApp:
     def __init__(self, model: InferenceModel, worker: ServingWorker,
                  input_queue: InputQueue, output_queue: OutputQueue,
                  frontend: Optional[HttpFrontend],
-                 redis_frontend=None):
+                 redis_frontend=None, reporter=None):
         self.model = model
         self.worker = worker
         self.input_queue = input_queue
         self.output_queue = output_queue
         self.frontend = frontend
         self.redis_frontend = redis_frontend
+        self.reporter = reporter
 
     @property
     def address(self) -> Optional[str]:
@@ -90,6 +91,8 @@ class ServingApp:
         if self.redis_frontend is not None:
             self.redis_frontend.stop()
         self.worker.stop()
+        if self.reporter is not None:
+            self.reporter.stop()
         logger.info("serving stopped")
 
 
@@ -176,6 +179,7 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     worker.start()
     frontend = None
     redis_fe = None
+    reporter = None
     try:
         if http.get("enabled", True):
             frontend = HttpFrontend(
@@ -206,14 +210,23 @@ def launch(config: Dict[str, Any]) -> ServingApp:
                 in_q, out_q, host=redis_cfg.get("host", "127.0.0.1"),
                 port=int(redis_cfg.get("port", 6379)),
                 name=redis_cfg.get("stream", "serving_stream")).serve()
+        # config-gated rollup logger (zoo.obs.report.interval seconds;
+        # 0 = off): the deployment's periodic rate/latency log line.
+        # Inside the guard: a malformed interval value must not leak
+        # the already-running worker/frontends
+        from analytics_zoo_tpu.obs.reporter import maybe_start_reporter
+
+        reporter = maybe_start_reporter()
     except Exception:
         # no ServingApp handle escapes; don't leak running pieces
         if frontend is not None:
             frontend.stop()
+        if redis_fe is not None:
+            redis_fe.stop()
         worker.stop()
         raise
     return ServingApp(model, worker, in_q, out_q, frontend,
-                      redis_frontend=redis_fe)
+                      redis_frontend=redis_fe, reporter=reporter)
 
 
 def launch_from_yaml(path: str) -> ServingApp:

@@ -24,6 +24,22 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.obs import tracing as _tracing
+from analytics_zoo_tpu.obs.metrics import get_registry as _get_registry
+
+# client-side data-plane counters (the queues' entry in the unified
+# registry): offered load, backpressure rejections, drained results
+_REG = _get_registry()
+_M_ENQ = _REG.counter(
+    "zoo_serving_enqueue_total",
+    "Requests offered to the serving input queue")
+_M_ENQ_REJECTED = _REG.counter(
+    "zoo_serving_enqueue_rejected_total",
+    "Requests rejected by input-queue backpressure (queue full)")
+_M_DEQ = _REG.counter(
+    "zoo_serving_dequeue_total",
+    "Results drained from the serving output queue")
+
 # Wire format. v1 was np.savez (one zip archive per request): simple,
 # but the zip machinery costs ~260 us per request round-trip -- it was
 # the single largest host cost of the serving cycle (measured on the
@@ -36,13 +52,18 @@ _ZIP_MAGIC = b"PK"  # np.savez container (legacy v1 blobs)
 
 
 def _encode(uri: str, payload: Dict[str, np.ndarray],
-            reply_to: Optional[str] = None) -> bytes:
+            reply_to: Optional[str] = None,
+            trace_id: Optional[str] = None) -> bytes:
     items = [("__uri__", np.asarray(uri))]
     if reply_to:
         # reply-to stream for brokered deployments: the worker that
         # serves the request routes the result back to the REQUESTER'S
         # result stream (several frontends can share one broker)
         items.append(("__reply__", np.asarray(reply_to)))
+    if trace_id:
+        # end-to-end tracing (obs.tracing): the id rides the blob so
+        # worker stages can span against it; absent when tracing is off
+        items.append(("__trace__", np.asarray(trace_id)))
     for k, v in payload.items():
         a = np.asarray(v)
         if not a.flags["C_CONTIGUOUS"]:
@@ -69,7 +90,7 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
     return b"".join(parts)
 
 
-_META_KEYS = ("__uri__", "__reply__")
+_META_KEYS = ("__uri__", "__reply__", "__trace__")
 
 
 def _decode(blob: bytes) -> Tuple[str, Dict[str, np.ndarray]]:
@@ -106,21 +127,32 @@ def _decode_raw(blob: bytes) -> Dict[str, np.ndarray]:
 
 def _decode_full(blob: bytes
                  ) -> Tuple[str, Dict[str, np.ndarray], Optional[str]]:
+    uri, tensors, reply, _ = _decode_traced(blob)
+    return uri, tensors, reply
+
+
+def _decode_traced(blob: bytes) -> Tuple[str, Dict[str, np.ndarray],
+                                         Optional[str], Optional[str]]:
+    """Full decode incl. the trace id meta key (what the worker's
+    decode stage uses; ``_decode_full`` keeps the historical 3-tuple)."""
     if blob[:4] == _MAGIC:
         z = _decode_raw(blob)
         uri = str(z["__uri__"].reshape(())) if "__uri__" in z else ""
         reply = (str(z["__reply__"].reshape(()))
                  if "__reply__" in z else None)
+        trace = (str(z["__trace__"].reshape(()))
+                 if "__trace__" in z else None)
         return uri, {k: v for k, v in z.items()
-                     if k not in _META_KEYS}, reply
+                     if k not in _META_KEYS}, reply, trace
     if not blob.startswith(_ZIP_MAGIC):
         raise ValueError("not a serving wire blob (neither AZT1 nor "
                          "legacy npz framing)")
     with np.load(io.BytesIO(blob), allow_pickle=False) as z:  # legacy v1
         uri = str(z["__uri__"])
         reply = str(z["__reply__"]) if "__reply__" in z.files else None
+        trace = str(z["__trace__"]) if "__trace__" in z.files else None
         return uri, {k: z[k] for k in z.files
-                     if k not in _META_KEYS}, reply
+                     if k not in _META_KEYS}, reply, trace
 
 
 class MemQueue:
@@ -485,9 +517,16 @@ class InputQueue:
 
     def enqueue(self, uri: str, **tensors) -> bool:
         """False means the queue is full (backpressure; the reference
-        surfaces Redis OOM errors here, client.py:176-192)."""
-        return self._q.put(_encode(uri, tensors,
-                                   reply_to=self.reply_stream))
+        surfaces Redis OOM errors here, client.py:176-192). A trace
+        context open on this thread (obs.tracing) rides the blob as
+        ``__trace__`` -- one thread-local read when tracing is off."""
+        ok = self._q.put(_encode(uri, tensors,
+                                 reply_to=self.reply_stream,
+                                 trace_id=_tracing.current_trace_id()))
+        _M_ENQ.inc()
+        if not ok:
+            _M_ENQ_REJECTED.inc()
+        return ok
 
     def enqueue_image(self, uri: str, data, key: str = "image") -> bool:
         """Enqueue a COMPRESSED image (JPEG/PNG file path or bytes);
@@ -528,7 +567,10 @@ class OutputQueue:
         polls; a positive timeout waits up to that many seconds and
         returns None on expiry."""
         blob = self._q.get(timeout)
-        return None if blob is None else _decode(blob)
+        if blob is None:
+            return None
+        _M_DEQ.inc()
+        return _decode(blob)
 
     def dequeue_all(self) -> List[Tuple[str, Dict[str, np.ndarray]]]:
         if hasattr(self._q, "get_many"):
@@ -537,6 +579,8 @@ class OutputQueue:
                 blobs = self._q.get_many(256)
                 out.extend(_decode(b) for b in blobs)
                 if len(blobs) < 256:
+                    if out:
+                        _M_DEQ.inc(len(out))
                     return out
         out = []
         while True:

@@ -1,47 +1,25 @@
-"""Per-stage timing statistics.
+"""Per-stage timing statistics (thin shim over the obs registry).
 
 The analog of serving ``Timer`` (ref: zoo/.../serving/engine/Timer.scala:
 24-90 -- total/avg/max/min/top-10 per stage, printed periodically) and the
 ``Supportive.timing`` wrapper (ref: zoo/.../serving/utils/Supportive.scala).
+
+Since ISSUE-2 the stat math lives in one place --
+:class:`analytics_zoo_tpu.obs.metrics.StatCore` -- and a Timer can
+*mirror* every stage duration into a labelled registry histogram family
+(``mirror=``), which is how the serving worker's stage summaries appear
+in ``GET /metrics`` Prometheus exposition while ``summary()`` keeps its
+historical per-instance dict shape.
 """
 
 from __future__ import annotations
 
-import heapq
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, List
+from typing import Dict, Optional
 
-
-class _StageStat:
-    __slots__ = ("count", "total", "max", "min", "top", "samples",
-                 "_cap")
-
-    def __init__(self, keep_samples: int = 0):
-        self.count = 0
-        self.total = 0.0
-        self.max = 0.0
-        self.min = float("inf")
-        self.top: List[float] = []  # min-heap of the 10 largest
-        # raw sample ring (percentiles); 0 disables
-        self.samples: List[float] = [] if keep_samples else None
-        self._cap = keep_samples
-
-    def record(self, dt: float) -> None:
-        self.count += 1
-        self.total += dt
-        self.max = max(self.max, dt)
-        self.min = min(self.min, dt)
-        if len(self.top) < 10:
-            heapq.heappush(self.top, dt)
-        else:
-            heapq.heappushpop(self.top, dt)
-        if self.samples is not None:
-            if len(self.samples) >= self._cap:
-                self.samples[self.count % self._cap] = dt
-            else:
-                self.samples.append(dt)
+from analytics_zoo_tpu.obs.metrics import Histogram, StatCore
 
 
 class Timer:
@@ -49,13 +27,18 @@ class Timer:
     summary gains p50_s/p99_s percentiles (the reference prints only
     total/avg/max/min/top-10, Timer.scala:24-90; percentiles are what
     the serving bench needs to split worker service time from client
-    latency)."""
+    latency). ``mirror``: an obs registry :class:`Histogram` family
+    labelled by ``stage`` -- every duration recorded here is also
+    observed there, so per-instance summaries and the process-wide
+    scrape surface stay in lockstep."""
 
-    def __init__(self, keep_samples: int = 0):
-        self._stats: Dict[str, _StageStat] = {}
-        self._gauges: Dict[str, _StageStat] = {}
+    def __init__(self, keep_samples: int = 0,
+                 mirror: Optional[Histogram] = None):
+        self._stats: Dict[str, StatCore] = {}
+        self._gauges: Dict[str, StatCore] = {}
         self._keep = keep_samples
         self._lock = threading.Lock()
+        self._mirror = mirror
 
     @contextmanager
     def timing(self, name: str, batch: int = 1):
@@ -64,26 +47,29 @@ class Timer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                self._stats.setdefault(
-                    name, _StageStat(self._keep)).record(dt)
+            self.record(name, time.perf_counter() - t0)
 
     def record(self, name: str, dt: float) -> None:
         """Record an externally-measured duration (spans that cross
         function boundaries, e.g. the worker's pipelined batch
         service time)."""
         with self._lock:
-            self._stats.setdefault(
-                name, _StageStat(self._keep)).record(dt)
+            stat = self._stats.get(name)
+            if stat is None:
+                stat = self._stats[name] = StatCore(self._keep)
+            stat.observe(dt)
+        if self._mirror is not None:
+            self._mirror.labels(stage=name).observe(dt)
 
     def gauge(self, name: str, value: float) -> None:
         """Record a sampled VALUE (queue depth, batch occupancy,
         in-flight count) rather than a duration; summarized under the
         ``gauges`` key of :meth:`summary` with unit-less stat names."""
         with self._lock:
-            self._gauges.setdefault(
-                name, _StageStat(self._keep)).record(float(value))
+            stat = self._gauges.get(name)
+            if stat is None:
+                stat = self._gauges[name] = StatCore(self._keep)
+            stat.observe(float(value))
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -91,35 +77,18 @@ class Timer:
             for name, s in self._stats.items():
                 if not s.count:
                     continue
-                out[name] = {
-                    "count": s.count,
-                    "total_s": s.total,
-                    "avg_s": s.total / s.count,
-                    "max_s": s.max,
-                    "min_s": s.min,
-                    "top10_avg_s": (sum(s.top) / len(s.top)
-                                    if s.top else 0.0),
-                }
-                if s.samples:
-                    ordered = sorted(s.samples)
-                    out[name]["p50_s"] = ordered[len(ordered) // 2]
-                    out[name]["p99_s"] = ordered[
-                        min(len(ordered) - 1, int(len(ordered) * 0.99))]
+                out[name] = s.summary("_s")
             gauges = {}
             for name, s in self._gauges.items():
                 if not s.count:
                     continue
-                gauges[name] = {
-                    "count": s.count,
-                    "avg": s.total / s.count,
-                    "max": s.max,
-                    "min": s.min,
-                }
-                if s.samples:
-                    ordered = sorted(s.samples)
-                    gauges[name]["p50"] = ordered[len(ordered) // 2]
-                    gauges[name]["p99"] = ordered[
-                        min(len(ordered) - 1, int(len(ordered) * 0.99))]
+                g = {"count": s.count, "avg": s.avg, "max": s.max,
+                     "min": s.min}
+                p50 = s.percentile(0.50)
+                if p50 is not None:
+                    g["p50"] = p50
+                    g["p99"] = s.percentile(0.99)
+                gauges[name] = g
             if gauges:
                 out["gauges"] = gauges
             return out

@@ -41,12 +41,40 @@ import numpy as np
 
 from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.obs.metrics import get_registry
+from analytics_zoo_tpu.obs.tracing import get_tracer
 from analytics_zoo_tpu.serving.batcher import AdaptiveBatcher, MicroBatcher
 from analytics_zoo_tpu.serving.queues import (
-    TcpQueue, _decode_full, _encode)
+    TcpQueue, _decode_traced, _encode)
 from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
+
+# unified-registry wiring (obs, ISSUE-2): stage latencies as one
+# labelled histogram family (every worker Timer mirrors into it),
+# request/error counters, and the pipeline's operational gauges --
+# the series HttpFrontend's /metrics Prometheus exposition scrapes
+_REG = get_registry()
+_M_STAGE = _REG.histogram(
+    "zoo_serving_stage_duration_seconds",
+    "Serving pipeline stage latency (decode, stack, predict_dispatch, "
+    "predict_fetch, postprocess, service, ...)", labelnames=("stage",))
+_M_SERVED = _REG.counter(
+    "zoo_serving_requests_total", "Requests answered by the worker "
+    "(successes and per-request error replies)")
+_M_ERRORS = _REG.counter(
+    "zoo_serving_errors_total",
+    "Per-request error replies pushed by the worker")
+_M_QUEUE_DEPTH = _REG.gauge(
+    "zoo_serving_queue_depth_items",
+    "Input-queue backlog observed behind the latest batch pull")
+_M_OCCUPANCY = _REG.histogram(
+    "zoo_serving_batch_occupancy_items",
+    "Requests per pulled micro-batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+_M_INFLIGHT = _REG.gauge(
+    "zoo_serving_inflight_batches_items",
+    "Dispatched batches awaiting finalize (pipeline window fill)")
 
 ERROR_KEY = "__error__"
 
@@ -109,13 +137,15 @@ def decode_image_batch(items):
     """Decode every image tensor across a whole micro-batch through the
     shared thread pool (batch-level parallelism beats per-request).
 
-    Returns ``(decoded_items, failures)`` where failures are
+    Items are ``(uri, tensors, reply, ...)`` tuples -- any tail beyond
+    the tensors (reply-to, trace id) passes through untouched. Returns
+    ``(decoded_items, failures)`` where failures are
     ``(uri, reply, message)`` for requests whose image bytes would not
     decode -- one corrupt upload must error that request, never the
     worker (same invariant as the per-blob decode guard)."""
     jobs = []
-    for idx, (uri, tensors, reply) in enumerate(items):
-        for k, v in tensors.items():
+    for idx, item in enumerate(items):
+        for k, v in item[1].items():
             a = np.asarray(v)
             if _is_image_bytes(a):
                 jobs.append((idx, k, a))
@@ -130,11 +160,11 @@ def decode_image_batch(items):
 
     pool = _image_pool()
     decoded = list(pool.map(safe_decode, jobs))
-    out = [(u, dict(t), r) for u, t, r in items]
+    out = [(item[0], dict(item[1])) + tuple(item[2:]) for item in items]
     bad = {}
     for (idx, k, _), img in zip(jobs, decoded):
         if isinstance(img, Exception):
-            uri, _, reply = items[idx]
+            uri, _, reply = items[idx][:3]
             bad[idx] = (uri, reply, f"image decode failed for "
                                     f"{k!r}: {img}")
         else:
@@ -167,7 +197,7 @@ def _default_output_fn(pred: Any) -> Dict[str, np.ndarray]:
 # in-flight records: either a dispatched batch awaiting finalize, or a
 # bundle of per-request errors funneled through the same FIFO so
 # responses keep dispatch order and one thread owns the served counter
-_BATCH = "batch"    # ("batch", uris, replies, preds, n, prep_s)
+_BATCH = "batch"    # ("batch", uris, replies, preds, n, prep_s, traces)
 _ERRORS = "errors"  # ("errors", [(uri, reply, message), ...])
 
 _SENTINEL = object()  # closes a pipeline stage
@@ -246,7 +276,11 @@ class ServingWorker:
         self.input_fn = input_fn
         self.output_fn = output_fn
         self.top_n = top_n
-        self.timer = timer or Timer(keep_samples=4096)
+        # default Timer mirrors every stage duration into the
+        # process-wide registry histogram (Prometheus /metrics); a
+        # caller-supplied timer keeps whatever mirroring it was built
+        # with
+        self.timer = timer or Timer(keep_samples=4096, mirror=_M_STAGE)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.served = 0
@@ -266,6 +300,13 @@ class ServingWorker:
         # metrics); set for the duration of a pipelined run
         self._inflight_q: Optional[_pyqueue.Queue] = None
 
+    def _count_served(self, n: int) -> None:
+        """Single owner of the served counters (instance total + the
+        process-wide registry counter)."""
+        self.served += n
+        if n:
+            _M_SERVED.inc(n)
+
     # ------------------------------------------------- synchronous loop --
     def process_one_batch(self, wait_timeout: float = 1.0) -> int:
         """One pull->predict->push cycle (the synchronous engine);
@@ -276,7 +317,7 @@ class ServingWorker:
             n = 0
             while self._inflight:  # idle: drain pipelined batches
                 n += self._finalize_one()
-            self.served += n
+            self._count_served(n)
             return n
         items, bad_images, decode_s = self._decode_stage(blobs)
         n_failed = 0
@@ -297,38 +338,53 @@ class ServingWorker:
             except Exception as e:  # input_fn/output_fn bugs must not
                 logger.exception(  # kill the serving thread
                     "serving batch failed: %s", e)
-                for uri, _, reply in group:
-                    self._push_error(uri, reply, str(e))
+                for item in group:
+                    self._push_error(item[0], item[2], str(e))
                 n += len(group)
         # finalize the oldest in-flight batches beyond the pipeline
         # depth (idle cycles drain the rest -- see the early return)
         while len(self._inflight) >= self.pipeline_depth:
             n += self._finalize_one()
-        self.served += n
+        self._count_served(n)
         return n
 
     # ------------------------------------------------------- stages -----
     def _decode_stage(self, blobs) -> Tuple[List, List, float]:
-        """npz-decode a pulled micro-batch, then image-decode through
+        """Wire-decode a pulled micro-batch, then image-decode through
         the shared thread pool. Returns (items, image_failures,
-        decode_seconds)."""
+        decode_seconds); items are (uri, tensors, reply, trace)."""
         t0 = time.perf_counter()
         with self.timer.timing("decode", batch=len(blobs)):
             items: List[Tuple[str, Dict[str, np.ndarray],
-                              Optional[str]]]
+                              Optional[str], Optional[str]]]
             try:  # fast path: no per-item try frames on clean batches
-                items = [_decode_full(b) for b in blobs]
+                items = [_decode_traced(b) for b in blobs]
             except Exception:
                 items = []
                 for b in blobs:
                     try:
-                        items.append(_decode_full(b))
+                        items.append(_decode_traced(b))
                     except Exception as e:  # malformed blob: drop,
                         logger.exception(   # keep serving
                             "serving: undecodable request dropped: %s",
                             e)
             items, bad_images = decode_image_batch(items)
-        return items, bad_images, time.perf_counter() - t0
+        t1 = time.perf_counter()
+        self._emit_spans("decode", (it[3] for it in items), t0, t1,
+                         batch=len(items))
+        return items, bad_images, t1 - t0
+
+    @staticmethod
+    def _emit_spans(name, traces, t0: float, t1: float, **args) -> None:
+        """One span per traced request covering this batch stage --
+        a no-op loop when nothing in the batch carries a trace id (the
+        tracing-disabled hot path)."""
+        tracer = None
+        for tr in traces:
+            if tr:
+                if tracer is None:
+                    tracer = get_tracer()
+                tracer.add_span(name, tr, t0, t1, **args)
 
     @staticmethod
     def _group_compatible(items):
@@ -336,10 +392,10 @@ class ServingWorker:
         stack into one device batch (ref: batchInput groups by model
         signature implicitly -- one model, one schema)."""
         groups: Dict[Any, List] = {}
-        for uri, tensors, reply in items:
+        for item in items:
             sig = tuple(sorted((k, v.shape, str(v.dtype))
-                               for k, v in tensors.items()))
-            groups.setdefault(sig, []).append((uri, tensors, reply))
+                               for k, v in item[1].items()))
+            groups.setdefault(sig, []).append(item)
         return list(groups.values())
 
     def _dispatch_group(self, group):
@@ -349,12 +405,13 @@ class ServingWorker:
         -- (``_BATCH``, ...) awaiting finalize, or (``_ERRORS``, ...)
         when dispatch failed. Stack/input_fn exceptions propagate (the
         caller owns the per-request error mapping for those)."""
-        uris = [u for u, _, _ in group]
-        replies = [r for _, _, r in group]
+        uris = [it[0] for it in group]
+        replies = [it[2] for it in group]
+        traces = [it[3] if len(it) > 3 else None for it in group]
         t0 = time.perf_counter()  # this group's own prep starts here
         with self.timer.timing("stack", batch=len(group)):
             stacked = {
-                k: np.stack([t[k] for _, t, _ in group])
+                k: np.stack([it[1][k] for it in group])
                 for k in group[0][1]
             }
             x = self.input_fn(stacked)
@@ -385,9 +442,11 @@ class ServingWorker:
         # prep time for THIS group: its share of the cycle's decode
         # stage + its own stack/dispatch (stored so the service metric
         # can exclude pipeline residency while other batches finalize)
+        t1 = time.perf_counter()
+        self._emit_spans("dispatch", traces, t0, t1, batch=len(group))
         prep_s = (getattr(self, "_decode_per_item", 0.0) * len(group)
-                  + time.perf_counter() - t0)
-        return (_BATCH, uris, replies, preds, n, prep_s)
+                  + t1 - t0)
+        return (_BATCH, uris, replies, preds, n, prep_s, traces)
 
     def _predict_group(self, group) -> int:
         rec = self._dispatch_group(group)
@@ -416,10 +475,13 @@ class ServingWorker:
                     "serving error-push failed (%d error replies "
                     "lost): %s", len(rec[1]), e)
             return len(rec[1])
-        _, uris, replies, preds, n, prep_s = rec
+        _, uris, replies, preds, n, prep_s, traces = rec
         t0 = time.perf_counter()
         try:
             served = self._finalize_inner(uris, replies, preds, n)
+            t1 = time.perf_counter()
+            self._emit_spans("finalize", traces, t0, t1,
+                             batch=len(uris))
             # worker-side service time for this batch: its own decode/
             # stack/dispatch prep + its remaining result wait + push.
             # Residency in the in-flight window while OTHER batches
@@ -428,8 +490,7 @@ class ServingWorker:
             # is "host work + un-overlapped device wait", the marginal
             # per-batch cost under pipelining (zero overlap = full
             # decode->predict->push)
-            self.timer.record("service",
-                              prep_s + time.perf_counter() - t0)
+            self.timer.record("service", prep_s + t1 - t0)
             return served
         except Exception as e:
             logger.exception("serving finalize failed (results for %d "
@@ -527,7 +588,9 @@ class ServingWorker:
                     depth = getattr(self.batcher, "last_depth", -1)
                     if depth >= 0:
                         self.timer.gauge("queue_depth", depth)
+                        _M_QUEUE_DEPTH.set(depth)
                     self.timer.gauge("batch_occupancy", len(blobs))
+                    _M_OCCUPANCY.observe(len(blobs))
                     if not put_stage(decoded_q,
                                      self._decode_stage(blobs)):
                         logger.warning(
@@ -555,7 +618,7 @@ class ServingWorker:
                                      "failed: %s", e)
                     n = len(rec[1])
                 served_box[0] += n
-                self.served += n
+                self._count_served(n)
 
         decode_t = threading.Thread(target=decode_loop, daemon=True,
                                     name="serving-decode")
@@ -585,11 +648,13 @@ class ServingWorker:
                         rec = self._dispatch_group(group)
                     except Exception as e:  # input_fn bugs etc.
                         logger.exception("serving batch failed: %s", e)
-                        rec = (_ERRORS, [(u, r, str(e))
-                                         for u, _, r in group])
+                        rec = (_ERRORS, [(it[0], it[2], str(e))
+                                         for it in group])
                     with self.timer.timing("inflight_wait"):
                         inflight_q.put(rec)  # blocks at the window cap
-                    self.timer.gauge("inflight", inflight_q.qsize())
+                    depth_now = inflight_q.qsize()
+                    self.timer.gauge("inflight", depth_now)
+                    _M_INFLIGHT.set(depth_now)
         finally:
             abort.set()
             dropped = 0
@@ -607,6 +672,10 @@ class ServingWorker:
             finalize_t.join()
             decode_t.join(timeout=5.0)
             self._inflight_q = None
+            # zero the operational gauges: a drained/stopped engine
+            # must not scrape as permanently-stuck backlog
+            _M_INFLIGHT.set(0)
+            _M_QUEUE_DEPTH.set(0)
         return served_box[0]
 
     # ------------------------------------------------------- lifecycle --
@@ -627,7 +696,7 @@ class ServingWorker:
         # answered (pipelined batches must not linger past the call)
         while self._inflight:
             n = self._finalize_one()
-            self.served += n
+            self._count_served(n)
             total += n
         return total
 
@@ -658,7 +727,7 @@ class ServingWorker:
                 return
             self._thread = None
         while self._inflight:  # flush: accepted requests must answer
-            self.served += self._finalize_one()
+            self._count_served(self._finalize_one())
 
     # --------------------------------------------------------- outputs --
     def _push(self, uri: str, reply: Optional[str],
@@ -684,6 +753,7 @@ class ServingWorker:
                     message: str) -> None:
         # reserved out-of-band key (the "__uri__" convention of
         # queues._encode) so model outputs named "error" stay usable
+        _M_ERRORS.inc()
         self._push(uri, reply, {ERROR_KEY: np.asarray(message)})
 
     # --------------------------------------------------------- metrics --

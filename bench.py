@@ -251,6 +251,14 @@ def measure_serving(seconds: float, batch: int):
                                                 np.uint8)},
             "http": {"enabled": False},
         })
+        # compile-counter baseline AFTER launch: warm_up's ladder
+        # compiles are expected; only compiles during the measured
+        # windows indicate requests paying live XLA stalls
+        from analytics_zoo_tpu.obs.metrics import get_registry as _gr
+
+        _compile_fam = _gr().get("zoo_inference_compile_total")
+        compiles_at_launch = (_compile_fam.value
+                              if _compile_fam is not None else 0)
         try:
             # the host->device tunnel is the client-observed ceiling on
             # this rig AND swings ~5x by the minute -- probe it before
@@ -376,6 +384,40 @@ def measure_serving(seconds: float, batch: int):
                              (time.perf_counter() - t0))
             worker_rps = max(rates)
 
+            # compact registry rollup (obs): queue depth / occupancy /
+            # in-flight / live compiles alongside the throughput
+            # numbers (3 short numeric keys -- the bench line has a
+            # 1500-char budget, so no full snapshot here)
+            from analytics_zoo_tpu.obs.metrics import get_registry
+
+            reg = get_registry()
+
+            def _snap(name, field="avg"):
+                fam = reg.get(name)
+                if fam is None:
+                    return 0
+                try:
+                    return (fam.snapshot(False).get(field, 0)
+                            if fam.kind == "histogram" else fam.value)
+                except Exception:
+                    return 0
+
+            # queue depth: the batcher's within-run mean (per pull),
+            # NOT the post-drain gauge value -- after the loop the
+            # queue is empty and the gauge reads ~0 regardless of the
+            # load the window ran under. compiles: delta since launch,
+            # so warm-up's expected ladder compiles don't read as
+            # mid-window stalls
+            obs = {
+                "occupancy_mean": round(float(_snap(
+                    "zoo_serving_batch_occupancy_items")), 1),
+                "queue_depth_mean": round(float(
+                    app.worker.batcher.stats().get(
+                        "mean_queue_depth", 0)), 1),
+                "compiles": int(_snap("zoo_inference_compile_total")
+                                - compiles_at_launch),
+            }
+
             return {
                 "rps": rps, "median_rps": median_rps,
                 "p50_ms": p50 * 1e3, "p99_ms": p99 * 1e3,
@@ -384,6 +426,7 @@ def measure_serving(seconds: float, batch: int):
                 "payload_kb": jpeg.size / 1024.0,
                 "tunnel_mbps": tunnel_mbps, "rejected": rejected,
                 "degraded": degraded, "stages": stages,
+                "obs": obs,
             }
         finally:
             app.stop()
@@ -619,6 +662,10 @@ def main():
             "serving_tunnel_mbps": round(serving["tunnel_mbps"], 1),
             "serving_windows_rejected": serving["rejected"],
             "serving_degraded": serving["degraded"],
+            # registry rollup (obs): the serving window's operational
+            # context -- mean batch occupancy, queue depth behind the
+            # last pull, and live XLA compiles during the window
+            "serving_obs": serving.get("obs", {}),
         })
     if flash_speedup is not None:
         extras["attn_flash_speedup_l2048"] = round(flash_speedup, 3)

@@ -77,11 +77,18 @@ def main():
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=20) as resp:
         print("http /predict:", json.loads(resp.read()).keys())
-    with urllib.request.urlopen(frontend.address + "/metrics",
+    with urllib.request.urlopen(frontend.address + "/metrics.json",
                                 timeout=20) as resp:
         metrics = json.loads(resp.read())
-        print("http /metrics stages:",
+        print("http /metrics.json keys:",
               sorted(metrics)[:4], "...")
+    # Prometheus text exposition (the scrape surface; obs registry)
+    with urllib.request.urlopen(frontend.address + "/metrics",
+                                timeout=20) as resp:
+        text = resp.read().decode()
+        print("http /metrics:",
+              sum(1 for ln in text.splitlines()
+                  if ln.startswith("zoo_")), "series lines")
     frontend.stop()
     worker.stop()
 

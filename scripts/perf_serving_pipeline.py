@@ -85,16 +85,41 @@ def _worker(model, in_q, out_q, pipelined):
                          pipeline_depth=PIPE_DEPTH)
 
 
+def _registry_snapshot():
+    from analytics_zoo_tpu.obs.metrics import get_registry
+
+    return {name: fam for name, fam in
+            get_registry().snapshot(with_buckets=False).items()
+            if name.startswith(("zoo_serving_", "zoo_inference_"))}
+
+
+def _registry_delta(before, after):
+    """This window's own registry activity (the registry is process-
+    global and cumulative, so without the delta a window's numbers
+    would blend in every preceding window's -- including the other
+    engine's). The diff itself is obs.metrics.snapshot_delta, shared
+    with the rollup reporter."""
+    from analytics_zoo_tpu.obs.metrics import snapshot_delta
+
+    return snapshot_delta(before, after)
+
+
 def saturation_window(model, pipelined, n, xs):
     """Pre-filled queue -> time to drain everything; returns (rps,
-    worker_metrics). The client side counts raw result blobs (one
-    get_many per poll) instead of tensor-decoding all of them: on this
-    1-core rig a full client decode costs ~10 us/request of the same
-    CPU the engine under test needs, which would understate BOTH
-    engines and dilute their ratio. A 64-result sample is still
-    decoded and validated per window."""
+    worker_metrics, registry_delta). Counter/histogram deltas cover
+    exactly this window; queue-depth/in-flight gauges are sampled at
+    the HALFWAY point of the drain (end-of-window gauges would show
+    the drained state, not the load). The
+    client side counts raw result blobs (one get_many per poll)
+    instead of tensor-decoding all of them: on this 1-core rig a full
+    client decode costs ~10 us/request of the same CPU the engine
+    under test needs, which would understate BOTH engines and dilute
+    their ratio. A 64-result sample is still decoded and validated
+    per window."""
     from analytics_zoo_tpu.serving.queues import (
         InputQueue, OutputQueue, _decode)
+
+    from analytics_zoo_tpu.obs.metrics import get_registry
 
     in_q, out_q = InputQueue(maxlen=n + 10), OutputQueue()
     for i in range(n):
@@ -102,22 +127,37 @@ def saturation_window(model, pipelined, n, xs):
     worker = _worker(model, in_q, out_q, pipelined)
     backend = out_q.queue
     sample = []
+    reg_before = _registry_snapshot()
     t0 = time.perf_counter()
     worker.start()
     done = 0
+    mid_gauges = None
     while done < n:
         got = backend.get_many(512)
         done += len(got)
         if not sample and got:
             sample = got[:64]
+        if mid_gauges is None and done >= n // 2:
+            # gauges sampled MID-drain: the end-of-window values are
+            # post-backlog (~0) and carry no signal about the load the
+            # window actually ran under
+            reg = get_registry()
+            mid_gauges = {
+                name: reg.get(name).value
+                for name in ("zoo_serving_queue_depth_items",
+                             "zoo_serving_inflight_batches_items")
+                if reg.get(name) is not None}
         if not got:
             time.sleep(0.002)
     dt = time.perf_counter() - t0
+    obs = _registry_delta(reg_before, _registry_snapshot())
+    for name, v in (mid_gauges or {}).items():
+        obs[name] = {"type": "gauge", "values": {"": v}}
     worker.stop()
     for blob in sample:  # spot-check real responses came back
         uri, tensors = _decode(blob)
         assert uri.startswith("r") and "output" in tensors, uri
-    return n / dt, worker.metrics()
+    return n / dt, worker.metrics(), obs
 
 
 def matched_load_window(model, pipelined, rps, seconds, xs):
@@ -191,12 +231,12 @@ def main():
     saturation_window(model, True, 500, xs)
 
     sync_rps, pipe_rps = [], []
-    pipe_metrics = None
+    pipe_metrics = pipe_obs = None
     for _ in range(args.windows):  # interleaved: shifts hit both
-        r, _ = saturation_window(model, False, args.requests, xs)
+        r, _, _ = saturation_window(model, False, args.requests, xs)
         sync_rps.append(r)
-        r, pipe_metrics = saturation_window(model, True, args.requests,
-                                            xs)
+        r, pipe_metrics, pipe_obs = saturation_window(
+            model, True, args.requests, xs)
         pipe_rps.append(r)
 
     best_sync, best_pipe = max(sync_rps), max(pipe_rps)
@@ -225,6 +265,11 @@ def main():
                                      1),
         "requests_per_window": args.requests,
         "cores": os.cpu_count(),
+        # this-window registry delta of the LAST pipelined saturation
+        # window (queue depth / occupancy / in-flight / compiles),
+        # captured while that engine was live -- the operational
+        # context BENCH_*.json records alongside the throughput
+        "registry": pipe_obs or {},
     }
     print(json.dumps(line))
 

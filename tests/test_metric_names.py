@@ -1,0 +1,101 @@
+"""Metric-name convention lint (ISSUE-2 satellite).
+
+Walks every module in ``analytics_zoo_tpu`` for registry registrations
+-- ``<obj>.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
+with a literal name -- and fails on names that break the
+``zoo_<subsystem>_<name>_<unit>`` convention or collide across modules
+(two modules registering the same family fragments ownership: help
+text, labels, and the lint's module attribution all become ambiguous;
+share the family object instead).
+
+Pytest-collected so the convention is CI, not a wiki page.
+"""
+
+import ast
+import os
+from typing import Dict, List, Tuple
+
+from analytics_zoo_tpu.obs.metrics import check_metric_name
+
+PACKAGE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "analytics_zoo_tpu")
+
+_REGISTER_METHODS = ("counter", "gauge", "histogram")
+
+
+def _is_registry_receiver(node: ast.AST) -> bool:
+    """Only calls on a *registry* count as registrations: a bare name
+    containing "reg" (``_REG``, ``registry``) or a direct
+    ``get_registry().x(...)`` chain. This keeps the per-instance Timer
+    API (``self.timer.gauge("queue_depth", v)``) -- sampled local
+    stats, not registry families -- out of the lint's scope."""
+    if isinstance(node, ast.Name):
+        return "reg" in node.id.lower()
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id == "get_registry"
+    return False
+
+
+def _registrations() -> List[Tuple[str, str, str]]:
+    """(module, kind, name) for every literal-name registration call
+    in the package source."""
+    found = []
+    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            module = os.path.relpath(path, os.path.dirname(PACKAGE))
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:  # lint must name the file
+                    raise AssertionError(f"unparsable {module}: {e}")
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _REGISTER_METHODS
+                        and _is_registry_receiver(node.func.value)
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                found.append((module, node.func.attr,
+                              node.args[0].value))
+    return found
+
+
+def test_package_registers_metrics():
+    """The walker works: the known serving/inference/learn families
+    are all found (an empty scan would vacuously pass the lint)."""
+    names = {name for _, _, name in _registrations()}
+    for expected in ("zoo_serving_requests_total",
+                     "zoo_serving_stage_duration_seconds",
+                     "zoo_serving_batch_close_total",
+                     "zoo_http_requests_total",
+                     "zoo_inference_compile_total",
+                     "zoo_learn_stage_duration_seconds",
+                     "zoo_learn_steps_total"):
+        assert expected in names, f"{expected} not registered anywhere"
+
+
+def test_metric_names_follow_convention():
+    bad = []
+    for module, kind, name in _registrations():
+        try:
+            check_metric_name(name, kind)
+        except ValueError as e:
+            bad.append(f"{module}: {e}")
+    assert not bad, "metric naming violations:\n" + "\n".join(bad)
+
+
+def test_no_cross_module_collisions():
+    owners: Dict[str, set] = {}
+    for module, _kind, name in _registrations():
+        owners.setdefault(name, set()).add(module)
+    collisions = {name: sorted(mods) for name, mods in owners.items()
+                  if len(mods) > 1}
+    assert not collisions, (
+        "metric families registered from multiple modules (move the "
+        f"registration to one owner and import the family): "
+        f"{collisions}")

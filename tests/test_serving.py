@@ -205,11 +205,27 @@ def test_http_predict_and_metrics(http_stack, tiny_model):
                          {"instances": [{"x": x}, {"x": x}]})
     assert status == 200 and len(body["predictions"]) == 2
 
-    with urllib.request.urlopen(http_stack.address + "/metrics",
+    # JSON snapshot API (the pre-ISSUE-2 /metrics dict moved here)
+    with urllib.request.urlopen(http_stack.address + "/metrics.json",
                                 timeout=10) as resp:
         metrics = json.loads(resp.read())
     assert metrics["worker"]["served"] >= 3
     assert "predict_request" in metrics["frontend"]
+    assert metrics["registry"]["zoo_serving_requests_total"]["type"] \
+        == "counter"
+
+    # /metrics is now Prometheus text exposition of the registry
+    with urllib.request.urlopen(http_stack.address + "/metrics",
+                                timeout=10) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    assert "# TYPE zoo_serving_requests_total counter" in text
+    assert "zoo_serving_stage_duration_seconds_bucket" in text
+
+    with urllib.request.urlopen(http_stack.address + "/healthz",
+                                timeout=10) as resp:
+        health = json.loads(resp.read())
+    assert health["status"] == "ok" and health["served"] >= 3
 
 
 def test_http_bad_request(http_stack):
