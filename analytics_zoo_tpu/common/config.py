@@ -82,6 +82,17 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.obs.trace.enabled": False,
     "zoo.obs.trace.max_spans": 8192,
     "zoo.obs.report.interval": 0.0,
+    # flight recorder (analytics_zoo_tpu.obs.flight / events): the
+    # always-on structured event ring, the crash postmortem bundle
+    # directory, and the recompile-storm detector (>= threshold
+    # distinct shapes for one jitted fn inside window_s seconds ->
+    # recompile_storm warning + zoo_obs_recompile_storms_total)
+    "zoo.obs.events.max_events": 2048,
+    "zoo.obs.flight.enabled": True,
+    "zoo.obs.postmortem.dir": "~/.cache/analytics-zoo-tpu/postmortems",
+    "zoo.obs.postmortem.max_events": 512,
+    "zoo.obs.recompile.window_s": 60.0,
+    "zoo.obs.recompile.threshold": 8,
     # inference
     "zoo.inference.default_dtype": "bfloat16",
     # XLA persistent compilation cache (see common.context.

@@ -27,12 +27,15 @@ of input-name -> array); wrap in ``jax.jit`` or hand it to
 from __future__ import annotations
 
 import struct
+import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from analytics_zoo_tpu.inference.importers import (
     _iter_fields, _read_varint, _signed)
+from analytics_zoo_tpu.obs.events import record_compile
 
 __all__ = ["GraphFunction", "load_tf_frozen_graph", "load_onnx_model",
            "UnsupportedOpError"]
@@ -87,6 +90,14 @@ class GraphFunction:
         missing = [n.op for n in nodes if n.op not in registry]
         if missing:
             raise UnsupportedOpError(missing, kind)
+        # compile-boundary bookkeeping: the first execute() per feed
+        # signature is a trace (eager: the first time XLA sees those
+        # op shapes; under jit: literally the trace the compile
+        # consumes) -- recorded as a compile event so graph-serving
+        # deployments get the same recompile-storm coverage as native
+        # models
+        self._seen_sigs: set = set()
+        self._sig_lock = threading.Lock()
 
     def __call__(self, *args, **kwargs):
         if len(args) == 1 and isinstance(args[0], dict) and not kwargs:
@@ -127,6 +138,14 @@ class GraphFunction:
                   else {**self.constants, **constants})
         env: Dict[str, Any] = dict(consts)
         env.update({k: jnp.asarray(v) for k, v in feed.items()})
+        sig = tuple(sorted(
+            (k, tuple(getattr(v, "shape", ()) or ()),
+             str(getattr(v, "dtype", ""))) for k, v in feed.items()))
+        with self._sig_lock:
+            fresh = sig not in self._seen_sigs
+            if fresh:
+                self._seen_sigs.add(sig)
+        t0 = time.perf_counter() if fresh else 0.0
         for node in self.nodes:
             ins = [None if dep is None else _resolve(env, *dep)
                    for dep in node.inputs]
@@ -139,6 +158,11 @@ class GraphFunction:
             else:
                 env[node.name] = out
         res = tuple(_resolve(env, n, i) for n, i in self._outputs)
+        if fresh:
+            record_compile(
+                f"graph.{self.kind}",
+                tuple((s, dt) for _, s, dt in sig),
+                time.perf_counter() - t0, subsystem="inference")
         return res[0] if len(res) == 1 else res
 
     @property

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import io
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -27,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.obs.events import record_compile, warming
 from analytics_zoo_tpu.obs.metrics import get_registry
 
 logger = get_logger(__name__)
@@ -258,14 +260,21 @@ class InferenceModel:
             np.asarray, example_input,
             is_leaf=lambda v: isinstance(v, list))
         done = set()
-        for bs in batch_sizes:
-            bucket = _bucket(bs)
-            if bucket in done:
-                continue
-            done.add(bucket)
-            batch = jax.tree_util.tree_map(
-                lambda a: np.repeat(a[:1], bucket, axis=0), example)
-            self.predict(batch)
+        # mark these compiles as intentional: warming the whole bucket
+        # ladder mints N distinct shapes in seconds, which must not
+        # read as a recompile storm. The warming() context is thread-
+        # local and reaches EVERY compile boundary the warm trace
+        # crosses (this bucket cache AND a graph-backed model's
+        # GraphFunction signatures)
+        with warming():
+            for bs in batch_sizes:
+                bucket = _bucket(bs)
+                if bucket in done:
+                    continue
+                done.add(bucket)
+                batch = jax.tree_util.tree_map(
+                    lambda a: np.repeat(a[:1], bucket, axis=0), example)
+                self.predict(batch)
         return self
 
     # ---------------------------------------------------------- predict --
@@ -318,11 +327,25 @@ class InferenceModel:
         key = self._shape_key(padded)
         with self._lock:
             fn = self._compiled.get(key)
-            if fn is None:
+            fresh = fn is None
+            if fresh:
                 fn = jax.jit(self._apply_fn)
                 self._compiled[key] = fn
                 _M_COMPILES.inc()
                 logger.info("inference: compiling bucket %s", key)
         _M_DISPATCH.inc()
         _M_PAD.observe((bucket - n) / bucket)
+        if fresh:
+            # first dispatch of a new bucket: jax traces + XLA-compiles
+            # synchronously inside this call, so its wall time ~= the
+            # compile stall requests behind it paid. The event feeds the
+            # recompile-storm detector -- a serving deployment whose
+            # traffic keeps minting new buckets (bad bucketing, ragged
+            # inputs) warns loudly instead of just running slow.
+            t0 = time.perf_counter()
+            out = fn(self.variables, padded)
+            record_compile("inference.predict", key,
+                           time.perf_counter() - t0,
+                           subsystem="inference")
+            return out, n
         return fn(self.variables, padded), n

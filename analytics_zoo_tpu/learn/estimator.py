@@ -35,6 +35,7 @@ from analytics_zoo_tpu.learn import checkpoint as ckpt_lib
 from analytics_zoo_tpu.learn.metrics import Metric, resolve_metric
 from analytics_zoo_tpu.learn.objectives import resolve_loss
 from analytics_zoo_tpu.learn.optim import resolve_optimizer
+from analytics_zoo_tpu.obs.events import emit, instrument_compiles
 from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.parallel import sharding
 from analytics_zoo_tpu.parallel.mesh import default_mesh
@@ -389,8 +390,14 @@ class Estimator:
             # transfer of this resident scalar
             return variables, opt_state, loss_sum + loss, loss
 
-        self._train_step = jax.jit(
-            step, donate_argnums=(0, 1, 2) if donate else ())
+        # compile-boundary instrumentation (obs.events): the first call
+        # per input signature is a trace+compile -- its wall time and
+        # abstract shapes land in the event log and feed the
+        # recompile-storm detector (a fit() whose batches keep changing
+        # shape recompiles every step and warns instead of crawling)
+        self._train_step = instrument_compiles(
+            jax.jit(step, donate_argnums=(0, 1, 2) if donate else ()),
+            "estimator.train_step", subsystem="learn")
         return self._train_step
 
     def _build_epoch_fn(self, batch_size: int, n_steps: int,
@@ -436,7 +443,9 @@ class Estimator:
             return variables, opt_state, loss_sum / n_steps
 
         donate = get_config().get("zoo.train.donate_buffers")
-        return jax.jit(epoch, donate_argnums=(0, 1) if donate else ())
+        return instrument_compiles(
+            jax.jit(epoch, donate_argnums=(0, 1) if donate else ()),
+            "estimator.epoch", subsystem="learn")
 
     def _eval_metrics(self) -> List[Metric]:
         """The tracked metrics plus a Loss metric when a loss is set."""
@@ -488,7 +497,8 @@ class Estimator:
                 out.append(s)
             return out
 
-        self._eval_step = jax.jit(step)
+        self._eval_step = instrument_compiles(
+            jax.jit(step), "estimator.eval_step", subsystem="learn")
         return self._eval_step
 
     # --------------------------------------------------------------- fit --
@@ -538,6 +548,8 @@ class Estimator:
             profiler = TrainingProfiler(trace_dir=trace_dir)
             self.last_profile = profiler
             profiler.start_trace()
+        emit("train_start", "learn", epochs=epochs,
+             batch_size=batch_size, device_cache=bool(device_cache))
         try:
             if device_cache:
                 if jax.process_count() > 1:
@@ -569,6 +581,8 @@ class Estimator:
                 if writer:
                     writer.close()
         finally:
+            emit("train_stop", "learn", epochs_run=self.epoch,
+                 global_step=self.global_step)
             if profiler is not None:
                 profiler.stop_trace()
                 logger.info("training profile: %s", profiler.summary())
@@ -678,6 +692,8 @@ class Estimator:
                      and len(failures) <= retry_times)
         logger.exception("training failure %d/%d in window: %s",
                          len(failures), retry_times, e)
+        emit("train_failure", "learn", error=repr(e),
+             failures=len(failures), retrying=can_retry)
         if not can_retry:
             return False
         # the restored model's loss/score are unknown until the next
@@ -844,9 +860,10 @@ class Estimator:
         adapter = self.adapter
 
         if "predict" not in self._predict_fns:
-            self._predict_fns["predict"] = jax.jit(
-                lambda variables, x: adapter.apply(variables, x,
-                                                   training=False)[0])
+            self._predict_fns["predict"] = instrument_compiles(
+                jax.jit(lambda variables, x: adapter.apply(
+                    variables, x, training=False)[0]),
+                "estimator.predict", subsystem="learn")
         fn = self._predict_fns["predict"]
 
         # globally-sharded outputs are not fully addressable per host;

@@ -12,6 +12,7 @@ Config-gated: ``zoo.obs.report.interval`` seconds between rollups;
 
 from __future__ import annotations
 
+import atexit
 import logging
 import threading
 import time
@@ -64,6 +65,7 @@ class Reporter:
         self._thread: Optional[threading.Thread] = None
         self._prev = self.registry.snapshot(with_buckets=False)
         self._prev_t = time.monotonic()
+        self._atexit_registered = False
 
     def tick(self, dt: Optional[float] = None) -> str:
         """One rollup (also the unit-testable core): snapshot, diff
@@ -96,13 +98,37 @@ class Reporter:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="obs-reporter")
         self._thread.start()
+        if not self._atexit_registered:
+            # a daemon thread dies wherever the interpreter catches it
+            # -- mid-interval, rollup lost. The atexit hook turns every
+            # process exit into a clean stop()+final flush, so the last
+            # partial interval still reaches the log (deployments read
+            # it as the run's closing line). stop() unregisters.
+            atexit.register(self.stop)
+            self._atexit_registered = True
         return self
 
-    def stop(self, join_timeout: float = 5.0) -> None:
+    def stop(self, join_timeout: float = 5.0,
+             flush: bool = True) -> None:
+        """Stop the rollup thread; with ``flush`` (default) log one
+        final rollup covering the partial interval since the last
+        tick."""
+        if self._atexit_registered:
+            atexit.unregister(self.stop)
+            self._atexit_registered = False
+        was_running = self._thread is not None
         self._stop.set()
         if self._thread is not None:
             self._thread.join(join_timeout)
             self._thread = None
+        if flush and was_running:
+            try:
+                line = self.tick()  # tick() logs the rollup itself
+                from analytics_zoo_tpu.obs.events import emit
+
+                emit("reporter_final", "obs", rollup=line[:500])
+            except Exception:  # interpreter teardown half-way through
+                pass
 
 
 def maybe_start_reporter() -> Optional[Reporter]:

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.obs.metrics import get_registry
 
 # why a batch closed, process-wide (obs registry): "size" = cap
@@ -181,9 +182,17 @@ class AdaptiveBatcher(MicroBatcher):
             self._occupancy_sum += len(batch)
             self._batches += 1
             self._depth_sum += max(0, depth)
+            prev_cap = self._last_cap
             self._last_cap = cap
             self._last_linger_ms = linger * 1000.0
             self.last_depth = depth
+        if cap != prev_cap:
+            # policy transitions only (a handful per load swing, never
+            # per batch): the event log shows WHEN the batcher grew
+            # into a bigger bucket -- the context for occupancy and
+            # close-reason shifts on the dashboard
+            emit_event("batch_cap_change", "serving", cap=cap,
+                       prev=prev_cap, depth=depth)
         return batch
 
     def stats(self) -> Dict[str, Any]:

@@ -14,6 +14,8 @@ with a deadline. Dependency-free wire format:
   GET  /metrics.json  JSON snapshot: registry + frontend/worker summaries
   GET  /healthz       liveness (200, or 503 when the worker thread died)
   GET  /trace         Chrome trace-event JSON of collected request spans
+  GET  /debug/events  structured event-log tail (?n=&type=&subsystem=)
+  GET  /debug/vars    resolved config + build/uptime/process info
 
 Unknown paths get a 404 with a JSON error body. With
 ``zoo.obs.trace.enabled`` each /predict carries a fresh trace id through
@@ -24,16 +26,23 @@ the frontend's ``http_request`` span under one id (docs/observability.md).
 from __future__ import annotations
 
 import json
+import os
+import sys
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
+from urllib.parse import parse_qs
 
 import numpy as np
 
+from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.log import get_logger
 from analytics_zoo_tpu.obs import tracing
+from analytics_zoo_tpu.obs.events import emit as emit_event
+from analytics_zoo_tpu.obs.events import get_event_log, to_jsonable
+from analytics_zoo_tpu.obs.flight import get_inflight
 from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.serving.timer import Timer
 from analytics_zoo_tpu.serving.worker import ERROR_KEY
@@ -56,7 +65,8 @@ _M_HTTP_DROPPED = _REG.counter(
 # everything else (scanners probing arbitrary 404 paths) collapses to
 # "other" so client-supplied URLs cannot grow the registry unboundedly
 _KNOWN_ROUTES = frozenset(
-    ("/predict", "/metrics", "/metrics.json", "/healthz", "/trace", "/"))
+    ("/predict", "/metrics", "/metrics.json", "/healthz", "/trace",
+     "/debug/events", "/debug/vars", "/"))
 
 
 class _ResultRouter:
@@ -201,6 +211,11 @@ class HttpFrontend:
                     self._reply(code, payload)
                 elif route == "/trace":
                     self._reply(200, tracing.get_tracer().chrome_trace())
+                elif route == "/debug/events":
+                    self._reply(200, frontend.debug_events(
+                        self.path.partition("?")[2]))
+                elif route == "/debug/vars":
+                    self._reply(200, frontend.debug_vars())
                 elif route == "/":
                     # welcome route (ref: FrontEndApp.scala:40)
                     self._reply(200, {"message": "welcome to analytics "
@@ -367,9 +382,11 @@ class HttpFrontend:
             target=self._server.serve_forever, daemon=True)
         self._server_thread.start()
         logger.info("serving frontend at %s", self.address)
+        emit_event("frontend_start", "serving", address=self.address)
         return self
 
     def stop(self) -> None:
+        emit_event("frontend_stop", "serving")
         self._server.shutdown()
         if self._server_thread is not None:
             self._server_thread.join(5.0)
@@ -388,6 +405,57 @@ class HttpFrontend:
         if self.worker is not None:
             out["worker"] = self.worker.metrics()
         out["registry"] = get_registry().snapshot()
+        return out
+
+    def debug_events(self, query: str = "") -> Dict[str, Any]:
+        """``GET /debug/events``: the structured event-log tail.
+        Query params: ``n`` (default 200), ``type``, ``subsystem`` --
+        filters apply before truncation, so ``?n=5&type=compile``
+        means the last 5 compiles."""
+        qs = parse_qs(query)
+
+        def one(key):
+            vals = qs.get(key)
+            return vals[-1] if vals else None
+
+        try:
+            n = int(one("n") or 200)
+        except ValueError:
+            n = 200
+        log = get_event_log()
+        events = log.tail(n, type=one("type"),
+                          subsystem=one("subsystem"))
+        # scalar-coerce the fields (numpy values, exceptions): an
+        # arbitrary emitter object must not 500 a debug endpoint
+        return {"events": [to_jsonable(e) for e in events],
+                "ring_len": len(log)}
+
+    def debug_vars(self) -> Dict[str, Any]:
+        """``GET /debug/vars``: resolved config + build/process info
+        (the expvar convention) -- what you diff first when two
+        deployments behave differently."""
+        out: Dict[str, Any] = {
+            "config": {k: v for k, v in sorted(
+                get_config().as_dict().items())},
+            "build": {
+                "python": sys.version.split()[0],
+                "platform": sys.platform,
+            },
+            "process": {
+                "pid": os.getpid(),
+                "argv": list(sys.argv),
+                "uptime_s": round(time.time() - self._started_at, 3),
+                "threads": len(threading.enumerate()),
+            },
+            "inflight_requests": get_inflight().snapshot(),
+        }
+        try:
+            import jax
+
+            out["build"]["jax"] = jax.__version__
+            out["build"]["backend"] = jax.default_backend()
+        except Exception:  # jax-free frontend processes stay served
+            pass
         return out
 
     def health(self):

@@ -57,8 +57,10 @@ import signal
 import threading
 from typing import Any, Dict, Optional
 
+from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.log import get_logger
 from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
 from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
 from analytics_zoo_tpu.serving.worker import ServingWorker
@@ -93,6 +95,7 @@ class ServingApp:
         self.worker.stop()
         if self.reporter is not None:
             self.reporter.stop()
+        emit_event("serving_stop", "serving")
         logger.info("serving stopped")
 
 
@@ -114,6 +117,14 @@ def _load_model(cfg: Dict[str, Any]) -> InferenceModel:
 
 def launch(config: Dict[str, Any]) -> ServingApp:
     """Assemble and start a deployment from a parsed config dict."""
+    # black box first: a deployment that dies during model load /
+    # warm-up should already leave a postmortem bundle. Library-level
+    # install (no signal hook -- launch() may run off the main thread);
+    # main() adds the SIGTERM bundle.
+    if get_config().get("zoo.obs.flight.enabled", True):
+        from analytics_zoo_tpu.obs.flight import install_flight_recorder
+
+        install_flight_recorder()
     model = _load_model(config)
     data = config.get("data") or {}
     params = config.get("params") or {}
@@ -217,7 +228,8 @@ def launch(config: Dict[str, Any]) -> ServingApp:
         from analytics_zoo_tpu.obs.reporter import maybe_start_reporter
 
         reporter = maybe_start_reporter()
-    except Exception:
+    except Exception as e:
+        emit_event("launch_failed", "serving", error=repr(e)[:500])
         # no ServingApp handle escapes; don't leak running pieces
         if frontend is not None:
             frontend.stop()
@@ -225,6 +237,12 @@ def launch(config: Dict[str, Any]) -> ServingApp:
             redis_fe.stop()
         worker.stop()
         raise
+    emit_event(
+        "serving_launch", "serving",
+        queue=str(data.get("queue") or "memory"),
+        pipelined=worker.pipelined,
+        http=bool(http.get("enabled", True)),
+        address=frontend.address if frontend is not None else None)
     return ServingApp(model, worker, in_q, out_q, frontend,
                       redis_frontend=redis_fe, reporter=reporter)
 
@@ -250,6 +268,14 @@ def main(argv=None) -> None:
 
     signal.signal(signal.SIGINT, handler)
     signal.signal(signal.SIGTERM, handler)
+    if get_config().get("zoo.obs.flight.enabled", True):
+        # SIGTERM bundle: the recorder's hook writes the postmortem,
+        # then chains to `handler` above (installed first) for the
+        # graceful drain -- orchestrated kills leave an artifact AND
+        # shut down cleanly
+        from analytics_zoo_tpu.obs.flight import install_flight_recorder
+
+        install_flight_recorder(signals=True)
     stop.wait()
     app.stop()
 

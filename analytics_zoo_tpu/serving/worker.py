@@ -41,6 +41,8 @@ import numpy as np
 
 from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.obs.events import emit as emit_event
+from analytics_zoo_tpu.obs.flight import get_inflight
 from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.obs.tracing import get_tracer
 from analytics_zoo_tpu.serving.batcher import AdaptiveBatcher, MicroBatcher
@@ -446,6 +448,10 @@ class ServingWorker:
         self._emit_spans("dispatch", traces, t0, t1, batch=len(group))
         prep_s = (getattr(self, "_decode_per_item", 0.0) * len(group)
                   + t1 - t0)
+        # dispatched-but-unanswered ids into the flight recorder's
+        # in-flight registry: a crash postmortem names exactly which
+        # requests were lost (one set update per BATCH, not per request)
+        get_inflight().add(uris)
         return (_BATCH, uris, replies, preds, n, prep_s, traces)
 
     def _predict_group(self, group) -> int:
@@ -478,7 +484,10 @@ class ServingWorker:
         _, uris, replies, preds, n, prep_s, traces = rec
         t0 = time.perf_counter()
         try:
-            served = self._finalize_inner(uris, replies, preds, n)
+            try:
+                served = self._finalize_inner(uris, replies, preds, n)
+            finally:  # answered (or accounted): off the crash manifest
+                get_inflight().discard(uris)
             t1 = time.perf_counter()
             self._emit_spans("finalize", traces, t0, t1,
                              batch=len(uris))
@@ -668,6 +677,7 @@ class ServingWorker:
             if dropped:
                 logger.warning("serving pipeline dropped %d decoded "
                                "requests on abnormal exit", dropped)
+                emit_event("pipeline_abort", "serving", dropped=dropped)
             inflight_q.put(_SENTINEL)
             finalize_t.join()
             decode_t.join(timeout=5.0)
@@ -701,16 +711,28 @@ class ServingWorker:
         return total
 
     def serve_forever(self) -> None:
-        self.run()
+        try:
+            self.run()
+        except BaseException as e:
+            # mark the death in the event log BEFORE re-raising so the
+            # flight recorder's postmortem (threading.excepthook fires
+            # next) carries the crash as its final event
+            emit_event("worker_crash", "serving", error=repr(e)[:500],
+                       served=self.served)
+            raise
 
     def start(self) -> "ServingWorker":
         self._stop.clear()
         self._thread = threading.Thread(target=self.serve_forever,
                                         daemon=True)
         self._thread.start()
+        emit_event("worker_start", "serving", pipelined=self.pipelined,
+                   batch_size=self.batcher.batch_size,
+                   pipeline_depth=self.pipeline_depth)
         return self
 
     def stop(self, join_timeout: float = 5.0) -> None:
+        emit_event("worker_stop", "serving", served=self.served)
         self._stop.set()
         thread = self._thread
         if thread is not None:
@@ -754,6 +776,12 @@ class ServingWorker:
         # reserved out-of-band key (the "__uri__" convention of
         # queues._encode) so model outputs named "error" stay usable
         _M_ERRORS.inc()
+        # error replies are rare by construction (the hot path never
+        # reaches here), so a structured event per error is cheap and
+        # makes /debug/events the first stop for "why did request X
+        # fail" instead of log spelunking
+        emit_event("serving_error", "serving", uri=uri,
+                   error=message[:500])
         self._push(uri, reply, {ERROR_KEY: np.asarray(message)})
 
     # --------------------------------------------------------- metrics --

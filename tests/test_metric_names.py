@@ -1,4 +1,5 @@
-"""Metric-name convention lint (ISSUE-2 satellite).
+"""Metric-name and event-type convention lint (ISSUE-2/ISSUE-3
+satellites).
 
 Walks every module in ``analytics_zoo_tpu`` for registry registrations
 -- ``<obj>.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
@@ -8,13 +9,22 @@ with a literal name -- and fails on names that break the
 text, labels, and the lint's module attribution all become ambiguous;
 share the family object instead).
 
-Pytest-collected so the convention is CI, not a wiki page.
+The same walk covers the structured event log: every literal
+``emit("<type>", ...)`` in the package must use a lower_snake_case
+type registered in ``obs.events.EVENT_TYPES`` -- the ONE vocabulary
+module -- so the event stream stays as disciplined as the metric
+namespace (an inline-invented type would never be documented,
+filtered, or postmortem-greppable).
+
+Pytest-collected so the conventions are CI, not a wiki page.
 """
 
 import ast
 import os
 from typing import Dict, List, Tuple
 
+from analytics_zoo_tpu.obs.events import (
+    EVENT_TYPE_RE, EVENT_TYPES, check_event_type)
 from analytics_zoo_tpu.obs.metrics import check_metric_name
 
 PACKAGE = os.path.join(os.path.dirname(os.path.dirname(
@@ -99,3 +109,95 @@ def test_no_cross_module_collisions():
         "metric families registered from multiple modules (move the "
         f"registration to one owner and import the family): "
         f"{collisions}")
+
+
+# ------------------------------------------------------------------ #
+# event-type vocabulary (ISSUE-3)                                     #
+# ------------------------------------------------------------------ #
+def _is_emit_call(node: ast.Call) -> bool:
+    """Any ``emit("...")`` / ``emit_event("...")`` / ``<obj>.emit("...")``
+    with a literal type string counts as an event emission."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in ("emit", "emit_event")
+    if isinstance(func, ast.Attribute):
+        return func.attr == "emit"
+    return False
+
+
+def _emissions() -> List[Tuple[str, str]]:
+    """(module, event_type) for every literal-type emit call in the
+    package source."""
+    found = []
+    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            module = os.path.relpath(path, os.path.dirname(PACKAGE))
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call) and _is_emit_call(node)
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    found.append((module, node.args[0].value))
+    return found
+
+
+def test_package_emits_events():
+    """The emit walker works (an empty scan would vacuously pass):
+    the known lifecycle/compile emissions are all found."""
+    types = {t for _, t in _emissions()}
+    for expected in ("compile", "recompile_storm", "worker_start",
+                     "worker_crash", "serving_error",
+                     "postmortem_written"):
+        assert expected in types, f"{expected} never emitted"
+
+
+def test_event_types_follow_convention():
+    """Every emitted literal type is lower_snake_case AND registered
+    in obs.events.EVENT_TYPES -- the one vocabulary module."""
+    bad = []
+    for module, etype in _emissions():
+        try:
+            check_event_type(etype)
+        except ValueError as e:
+            bad.append(f"{module}: {e}")
+    assert not bad, "event type violations:\n" + "\n".join(bad)
+
+
+def test_event_vocabulary_names_are_snake_case():
+    """The registry itself stays clean: every registered type matches
+    the lower_snake_case regex and carries a description."""
+    for name, desc in EVENT_TYPES.items():
+        assert EVENT_TYPE_RE.match(name), name
+        assert desc and isinstance(desc, str), name
+
+
+def test_event_vocabulary_single_module():
+    """EVENT_TYPES is assigned in obs/events.py and nowhere else --
+    a second vocabulary module would fragment the namespace exactly
+    the way cross-module metric registration would."""
+    owners = []
+    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.target:
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and \
+                            t.id == "EVENT_TYPES":
+                        owners.append(os.path.relpath(
+                            path, os.path.dirname(PACKAGE)))
+    assert owners == [os.path.join("analytics_zoo_tpu", "obs",
+                                   "events.py")], owners
