@@ -1,0 +1,58 @@
+"""zoolint: repo-native static analysis for TPU-serving hygiene.
+
+The runtime observability stack (obs.flight's recompile-storm
+detector, the serving worker's crash events) catches shape churn and
+thread death *after* they ship; this package is the static twin --
+an AST-level checker framework that catches the same bug classes at
+review time:
+
+- ``trace_hazards``  jit/pmap/shard_map retrace + concretization
+                     hazards (the lint form of obs.events'
+                     RecompileDetector)
+- ``concurrency``    lock-guard inference, lock-ordering, and
+                     thread-join lints for the threaded serving/obs
+                     layers
+- ``config_keys``    ``zoo.*`` config-key drift between use sites,
+                     ``common.config._DEFAULTS``, and the docs
+                     glossary (resolves helper-wrapper/prefix access
+                     that naive grep misses)
+- ``vocabulary``     metric-name and event-type conventions (one
+                     registry with obs.metrics / obs.events)
+- ``hygiene``        silent ``except Exception: pass`` blocks
+
+Entry points: ``scripts/zoolint.py`` (CLI, baseline-aware, ``--json``)
+and ``tests/test_zoolint.py`` (tier-1 gate). Findings suppress inline
+with ``# zoolint: disable=<rule>`` on the offending or preceding line;
+grandfathered findings live in ``zoolint_baseline.json`` with a
+rationale each. See docs/zoolint.md for the rule catalog.
+"""
+
+from analytics_zoo_tpu.analysis.core import (  # noqa: F401
+    Checker,
+    Finding,
+    Project,
+    SourceFile,
+    all_checkers,
+    all_rules,
+    register,
+    run_zoolint,
+)
+from analytics_zoo_tpu.analysis.baseline import (  # noqa: F401
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+
+__all__ = [
+    "Checker",
+    "Finding",
+    "Project",
+    "SourceFile",
+    "all_checkers",
+    "all_rules",
+    "register",
+    "run_zoolint",
+    "load_baseline",
+    "new_findings",
+    "write_baseline",
+]

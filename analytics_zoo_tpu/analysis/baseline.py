@@ -1,0 +1,74 @@
+"""Baseline file support: grandfathered findings with rationales.
+
+The baseline (``zoolint_baseline.json`` at the repo root) is the
+checked-in set of findings a past reviewer accepted -- each entry
+carries a ``rationale`` string saying *why* it is allowed to stay
+(an inline ``# zoolint: disable=`` is preferred for new code; the
+baseline exists so turning a new rule on does not require touching
+every historical site in the same PR). The CLI exits non-zero only on
+findings **not** in the baseline, and ``--update-baseline`` rewrites
+the file preserving rationales for entries that survive.
+
+Identity is :meth:`Finding.key` -- ``(rule, path, message)``, no line
+numbers -- so the baseline tolerates edits elsewhere in a file but
+goes stale the moment the flagged symbol itself changes (which is the
+point: changed code must re-justify its exemption).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from analytics_zoo_tpu.analysis.core import Finding
+
+BaselineKey = Tuple[str, str, str]
+
+
+def load_baseline(path: str) -> Dict[BaselineKey, Dict]:
+    """{(rule, path, message): entry}; empty when the file is absent."""
+    if not path or not os.path.isfile(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[BaselineKey, Dict] = {}
+    for entry in data.get("findings", []):
+        key = (entry["rule"], entry["path"], entry["message"])
+        out[key] = entry
+    return out
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Dict[BaselineKey, Dict]) -> List[Finding]:
+    return [f for f in findings if f.key() not in baseline]
+
+
+def stale_entries(findings: Sequence[Finding],
+                  baseline: Dict[BaselineKey, Dict]) -> List[Dict]:
+    """Baseline entries whose finding no longer fires (fixed code or a
+    renamed symbol) -- reported so the baseline shrinks over time
+    instead of accreting dead exemptions."""
+    live = {f.key() for f in findings}
+    return [e for k, e in sorted(baseline.items()) if k not in live]
+
+
+def write_baseline(findings: Sequence[Finding], path: str,
+                   previous: Dict[BaselineKey, Dict]) -> int:
+    """Write every current finding as a baseline entry, carrying over
+    rationales from ``previous`` where the key survives. Returns the
+    entry count."""
+    entries = []
+    for f in sorted(findings, key=lambda f: f.key()):
+        prev = previous.get(f.key(), {})
+        entries.append({
+            "rule": f.rule,
+            "path": f.path,
+            "message": f.message,
+            "severity": f.severity,
+            "rationale": prev.get("rationale", ""),
+        })
+    with open(path, "w") as out:
+        json.dump({"findings": entries}, out, indent=2, sort_keys=True)
+        out.write("\n")
+    return len(entries)

@@ -1,0 +1,251 @@
+"""Checker family 2: concurrency lints for the threaded layers.
+
+The serving data plane (worker/batcher/queues/frontends) and the obs
+stack are the only deliberately multi-threaded parts of the package,
+so these rules are scoped to files under ``serving/`` and ``obs/`` by
+default (``restrict_dirs=None`` lifts the scope -- unit-test
+fixtures). Three rules:
+
+``lock-guard`` (warning)
+    Lock-guard inference: within one class, an attribute assigned
+    both inside ``with self.<lock>:`` and outside it (in non-init
+    methods) is either missing a guard at the unguarded site or
+    carrying a redundant one at the guarded site -- both are worth a
+    human look. ``__init__``/``__new__`` are exempt (construction
+    happens-before publication), as are the lock attributes
+    themselves.
+
+``lock-order`` (error)
+    Two locks of one class acquired nested in opposite orders across
+    methods: the classic ABBA deadlock, invisible until the unlucky
+    interleaving ships.
+
+``thread-join`` (warning)
+    A non-daemon ``threading.Thread`` whose owner never calls
+    ``.join`` on it: process exit then blocks on the forgotten
+    thread. Either pass ``daemon=True`` (and accept hard-kill
+    semantics) or join it in the stop path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from analytics_zoo_tpu.analysis.core import (
+    Checker, Finding, SourceFile, register)
+
+_INIT_METHODS = {"__init__", "__new__", "__init_subclass__"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lock_name_of_with_item(item: ast.withitem) -> Optional[str]:
+    """Attr name for ``with self.<name>:`` items that look like locks
+    (name contains 'lock' or 'mutex'), incl. ``self._lock.acquire``-
+    style guards via ``with self._lock:`` only."""
+    attr = _self_attr(item.context_expr)
+    if attr and ("lock" in attr.lower() or "mutex" in attr.lower()):
+        return attr
+    return None
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr == "Thread":
+        root = func.value
+        return isinstance(root, ast.Name) and root.id == "threading"
+    return isinstance(func, ast.Name) and func.id == "Thread"
+
+
+def _daemon_true(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "daemon":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True)
+    return False
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method: every self-attr assignment tagged with the lock
+    stack active at that point, plus nested lock-acquisition pairs.
+    Nested function defs are traversed (closures mutate state too);
+    nested class defs are not."""
+
+    def __init__(self):
+        self.lock_stack: List[str] = []
+        # attr -> set of "guarded by" frozensets observed
+        self.writes: List[Tuple[str, Tuple[str, ...], int]] = []
+        self.pairs: List[Tuple[str, str, int]] = []
+        self.locks_seen: Set[str] = set()
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            name = _lock_name_of_with_item(item)
+            if name:
+                self.locks_seen.add(name)
+                for held in self.lock_stack:
+                    if held != name:
+                        self.pairs.append((held, name, node.lineno))
+                acquired.append(name)
+        self.lock_stack.extend(acquired)
+        self.generic_visit(node)
+        for _ in acquired:
+            self.lock_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _record_targets(self, targets, lineno: int) -> None:
+        for t in targets:
+            for node in ast.walk(t):
+                attr = _self_attr(node)
+                if attr:
+                    self.writes.append(
+                        (attr, tuple(self.lock_stack), lineno))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._record_targets(node.targets, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_targets([node.target], node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_targets([node.target], node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        self._record_targets(node.targets, node.lineno)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        return  # a nested class is its own synchronization domain
+
+
+@register
+class ConcurrencyChecker(Checker):
+    name = "concurrency"
+    rules = {
+        "lock-guard": "attribute assigned both inside and outside "
+                      "'with self.<lock>:' in the same class",
+        "lock-order": "two locks acquired nested in opposite orders "
+                      "across methods (ABBA deadlock)",
+        "thread-join": "non-daemon threading.Thread never joined by "
+                       "its owner",
+    }
+
+    def __init__(self, restrict_dirs: Optional[Tuple[str, ...]] = (
+            "serving", "obs")):
+        self.restrict_dirs = restrict_dirs
+
+    def _in_scope(self, src: SourceFile) -> bool:
+        if self.restrict_dirs is None:
+            return True
+        parts = src.rel.split("/")
+        return any(d in parts for d in self.restrict_dirs)
+
+    # ----------------------------------------------------- per class --
+    def _check_class(self, src: SourceFile,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        # attr -> {"guarded": {(method, line)}, "bare": {(method, line)}}
+        guarded: Dict[str, List[Tuple[str, int]]] = {}
+        bare: Dict[str, List[Tuple[str, int]]] = {}
+        # (lockA, lockB) -> [(method, line)] for A held while taking B
+        order: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+        locks: Set[str] = set()
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            scan = _MethodScan()
+            for stmt in item.body:
+                scan.visit(stmt)
+            locks |= scan.locks_seen
+            for a, b, line in scan.pairs:
+                order.setdefault((a, b), []).append((item.name, line))
+            if item.name in _INIT_METHODS:
+                continue  # construction happens-before publication
+            for attr, held, line in scan.writes:
+                if held:
+                    guarded.setdefault(attr, []).append(
+                        (item.name, line))
+                else:
+                    bare.setdefault(attr, []).append((item.name, line))
+        for attr in sorted(set(guarded) & set(bare)):
+            if attr in locks:
+                continue
+            g_methods = sorted({m for m, _ in guarded[attr]})
+            b_methods = sorted({m for m, _ in bare[attr]})
+            line = min(l for _, l in bare[attr])
+            yield Finding(
+                "lock-guard", "warning", src.rel, line,
+                f"{cls.name}.{attr} is assigned under a lock in "
+                f"{', '.join(g_methods)} but without one in "
+                f"{', '.join(b_methods)}; guard the bare writes or "
+                "document why they are safe")
+        for (a, b), sites in sorted(order.items()):
+            if (b, a) in order and a < b:  # report each pair once
+                m1 = sorted({m for m, _ in sites})
+                m2 = sorted({m for m, _ in order[(b, a)]})
+                line = min(l for _, l in sites)
+                yield Finding(
+                    "lock-order", "error", src.rel, line,
+                    f"{cls.name} acquires self.{a} then self.{b} in "
+                    f"{', '.join(m1)} but self.{b} then self.{a} in "
+                    f"{', '.join(m2)}; pick one order (ABBA "
+                    "deadlock)")
+
+    # --------------------------------------------------- thread-join --
+    def _check_threads(self, src: SourceFile) -> Iterable[Finding]:
+        # parent links so a Thread(...) call can find its Assign
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(src.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        joined: Set[str] = set()  # attr or local names .join()-ed
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr == "join"):
+                base = _self_attr(node.value)
+                if base is None and isinstance(node.value, ast.Name):
+                    base = node.value.id
+                if base:
+                    joined.add(base)
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_thread_ctor(node)):
+                continue
+            if _daemon_true(node):
+                continue
+            parent = parents.get(id(node))
+            target_name: Optional[str] = None
+            if isinstance(parent, ast.Assign) and parent.targets:
+                t = parent.targets[0]
+                target_name = _self_attr(t) or (
+                    t.id if isinstance(t, ast.Name) else None)
+            if target_name and target_name in joined:
+                continue
+            where = (f"bound to '{target_name}'" if target_name
+                     else "unbound (started inline?)")
+            yield Finding(
+                "thread-join", "warning", src.rel, node.lineno,
+                f"non-daemon threading.Thread {where} is never "
+                "joined; pass daemon=True or join it in the stop "
+                "path so process exit cannot hang")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        if not self._in_scope(src):
+            return
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(src, node)
+        yield from self._check_threads(src)

@@ -1,0 +1,183 @@
+"""Checker family 3: ``zoo.*`` config-key drift.
+
+Ground truth is the ``_DEFAULTS`` dict in ``common/config.py`` (the
+checker finds it structurally -- any scanned file with a module-level
+``_DEFAULTS = {...}`` of string keys -- so fixture projects work).
+Three rules close the drift triangle between use sites, declarations,
+and docs:
+
+``config-undeclared`` (error)
+    A ``.get("zoo.x")`` / ``.set(...)`` / ``.unset(...)`` call on a
+    literal key missing from ``_DEFAULTS``: either a typo'd key
+    silently reading its fallback, or a real knob nobody declared.
+
+``config-unused`` (warning)
+    A declared key with no use site anywhere in the scanned tree.
+    Use sites include **indirect prefix access** -- the helper-wrapper
+    idiom ``cfg.get("zoo.mesh.axis." + kind)`` /
+    ``f"zoo.mesh.axis.{kind}"`` marks every declared key under that
+    prefix as used (a naive grep flags exactly these as dead).
+
+``config-undocumented`` (warning)
+    A declared key never mentioned in ``docs/*.md``. Every knob in
+    the glossary or it does not exist. Skipped when the project has
+    no docs tree (fixtures).
+
+Docstring string constants are excluded from use-site detection: a
+key *described* in prose is not a key *read*.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from analytics_zoo_tpu.analysis.core import (
+    Checker, Finding, Project, SourceFile, register)
+
+_KEY_RE = re.compile(r"^zoo(\.[a-z0-9_]+)+$")
+_CONFIG_METHODS = {"get", "set", "unset"}
+
+
+def _defaults_decl(src: SourceFile
+                   ) -> Optional[Dict[str, int]]:
+    """{key: lineno} when this module assigns a dict of zoo.* string
+    keys to ``_DEFAULTS`` at top level."""
+    for node in src.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # _DEFAULTS: Dict[...] = {}
+            targets = [node.target]
+        if not (any(isinstance(t, ast.Name) and t.id == "_DEFAULTS"
+                    for t in targets)
+                and isinstance(getattr(node, "value", None), ast.Dict)):
+            continue
+        out: Dict[str, int] = {}
+        for k in node.value.keys:
+            if (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and _KEY_RE.match(k.value)):
+                out[k.value] = k.lineno
+        if out:
+            return out
+    return None
+
+
+def _literal_prefix(node: ast.AST) -> Optional[str]:
+    """Leading literal of a dynamically-built key: ``"zoo.a." + x``,
+    ``f"zoo.a.{x}"``, ``"zoo.a.%s" % x``, ``"zoo.a.{}".format(x)``."""
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.Add, ast.Mod)):
+        return _literal_prefix(node.left)
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            return first.value
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "format"):
+        return _literal_prefix(node.func.value)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _Uses:
+    def __init__(self):
+        self.literals: Dict[str, List[Tuple[str, int]]] = {}
+        self.prefixes: Dict[str, List[Tuple[str, int]]] = {}
+        # literal keys passed to a config get/set/unset call
+        self.config_calls: Dict[str, List[Tuple[str, int]]] = {}
+
+
+def collect_uses(project: Project,
+                 skip: Optional[SourceFile] = None) -> _Uses:
+    uses = _Uses()
+    for src in project.files:
+        if src is skip:
+            continue
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _KEY_RE.match(node.value)
+                    and not src.is_docstring(node)):
+                uses.literals.setdefault(node.value, []).append(
+                    (src.rel, node.lineno))
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in _CONFIG_METHODS
+                        and node.args):
+                    arg = node.args[0]
+                    if (isinstance(arg, ast.Constant)
+                            and isinstance(arg.value, str)
+                            and arg.value.startswith("zoo.")):
+                        uses.config_calls.setdefault(
+                            arg.value, []).append(
+                                (src.rel, arg.lineno))
+                    else:
+                        prefix = _literal_prefix(arg)
+                        if prefix and prefix.startswith("zoo."):
+                            uses.prefixes.setdefault(
+                                prefix, []).append(
+                                    (src.rel, arg.lineno))
+    return uses
+
+
+@register
+class ConfigKeyChecker(Checker):
+    name = "config"
+    rules = {
+        "config-undeclared": "config API call on a zoo.* key missing "
+                             "from common.config _DEFAULTS",
+        "config-unused": "declared _DEFAULTS key with no use site "
+                         "(direct or prefix-wrapper) in the scanned "
+                         "tree",
+        "config-undocumented": "declared _DEFAULTS key never "
+                               "mentioned in docs/*.md",
+    }
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        decl_src: Optional[SourceFile] = None
+        declared: Dict[str, int] = {}
+        for src in project.files:
+            found = _defaults_decl(src)
+            if found:
+                decl_src, declared = src, found
+                break
+        if decl_src is None:
+            return  # nothing to reconcile against
+        uses = collect_uses(project, skip=decl_src)
+
+        for key, sites in sorted(uses.config_calls.items()):
+            if key in declared:
+                continue
+            rel, line = sites[0]
+            yield Finding(
+                "config-undeclared", "error", rel, line,
+                f"config key '{key}' is read/written but not declared "
+                "in common.config _DEFAULTS (typo, or add the "
+                "default)")
+
+        used_keys: Set[str] = set(uses.literals) | set(
+            uses.config_calls)
+        prefix_list = sorted(uses.prefixes)
+        docs = project.docs_text()
+        for key, line in sorted(declared.items()):
+            direct = key in used_keys
+            via_prefix = any(key.startswith(p) for p in prefix_list)
+            if not direct and not via_prefix:
+                yield Finding(
+                    "config-unused", "warning", decl_src.rel, line,
+                    f"config key '{key}' is declared in _DEFAULTS but "
+                    "never read anywhere in the scanned tree (wire it "
+                    "up, or delete/document it)")
+            if docs and key not in docs:
+                yield Finding(
+                    "config-undocumented", "warning", decl_src.rel,
+                    line,
+                    f"config key '{key}' is not mentioned in any "
+                    "docs/*.md; add it to the config glossary")

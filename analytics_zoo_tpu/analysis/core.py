@@ -1,0 +1,268 @@
+"""zoolint engine: findings, suppression, checker registry, runner.
+
+Design rules (shared by every checker family):
+
+- **One parse per file.** :class:`SourceFile` owns the text, the line
+  table, the AST, and the per-line suppression sets; checkers never
+  re-read disk.
+- **Stable finding identity.** A finding's baseline key is
+  ``(rule, path, message)`` -- messages must therefore name *symbols*
+  (class, method, attribute, config key), never line numbers, so the
+  baseline survives unrelated edits above the finding.
+- **Two checker shapes.** ``check_file`` runs per file (trace hazards,
+  concurrency, hygiene); ``check_project`` runs once over the whole
+  file set (config drift, vocabulary collisions -- anything whose
+  ground truth spans modules).
+- **Suppression is local and named.** ``# zoolint: disable=<rule>``
+  (comma-separated, or ``all``) on the flagged line or the line above
+  silences exactly that rule there; unexplained global ignores don't
+  exist. Grandfathered findings go in the baseline file with a
+  rationale instead (analysis.baseline).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SEVERITIES = ("error", "warning", "info")
+
+_SUPPRESS_RE = re.compile(r"#\s*zoolint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit. ``path`` is root-relative with ``/`` separators;
+    ``line`` is 1-based (0 for whole-file/project findings)."""
+
+    rule: str
+    severity: str
+    path: str
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers excluded on purpose so the
+        baseline survives edits elsewhere in the file."""
+        return (self.rule, self.path, self.message)
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.severity} "
+                f"[{self.rule}] {self.message}")
+
+
+class SourceFile:
+    """One parsed python file: text, lines, AST, suppressions,
+    docstring-constant ids (so string scans can skip docs prose)."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self._suppress: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                self._suppress[i] = rules
+        self._docstrings = self._collect_docstrings(self.tree)
+
+    @staticmethod
+    def _collect_docstrings(tree: ast.AST) -> Set[int]:
+        ids: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Module, ast.ClassDef,
+                                 ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                body = getattr(node, "body", [])
+                if (body and isinstance(body[0], ast.Expr)
+                        and isinstance(body[0].value, ast.Constant)
+                        and isinstance(body[0].value.value, str)):
+                    ids.add(id(body[0].value))
+        return ids
+
+    def is_docstring(self, node: ast.AST) -> bool:
+        return id(node) in self._docstrings
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """True when the line (or the line directly above it) carries
+        ``# zoolint: disable=`` naming this rule or ``all``."""
+        for ln in (line, line - 1):
+            rules = self._suppress.get(ln)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+
+class Project:
+    """The unit ``check_project`` sees: every parsed file plus the
+    repo root (for the docs glossary scan)."""
+
+    def __init__(self, files: Sequence[SourceFile],
+                 repo_root: Optional[str] = None):
+        self.files = list(files)
+        self.repo_root = repo_root
+        self._by_rel = {f.rel: f for f in self.files}
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        return self._by_rel.get(rel.replace(os.sep, "/"))
+
+    def docs_text(self) -> str:
+        """Concatenated ``docs/*.md`` under the repo root (empty when
+        there is no docs tree -- checkers skip doc rules then)."""
+        if not self.repo_root:
+            return ""
+        docs = os.path.join(self.repo_root, "docs")
+        if not os.path.isdir(docs):
+            return ""
+        parts = []
+        for name in sorted(os.listdir(docs)):
+            if name.endswith(".md"):
+                try:
+                    with open(os.path.join(docs, name)) as f:
+                        parts.append(f.read())
+                except OSError:
+                    continue
+        return "\n".join(parts)
+
+
+class Checker:
+    """Base class. Subclasses set ``name`` (family), ``rules``
+    ({rule: one-line description}), and override ``check_file``
+    and/or ``check_project``."""
+
+    name: str = ""
+    rules: Dict[str, str] = {}
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator adding a Checker to the global registry."""
+    if not issubclass(cls, Checker) or not cls.name:
+        raise TypeError(f"{cls!r} is not a named Checker")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def _load_builtin_checkers() -> None:
+    # import for side effect: each module @register-s its checkers
+    from analytics_zoo_tpu.analysis import (  # noqa: F401
+        concurrency, config_keys, hygiene, trace_hazards, vocabulary)
+
+
+def all_checkers() -> List[Checker]:
+    _load_builtin_checkers()
+    return [cls() for _, cls in sorted(_REGISTRY.items())]
+
+
+def all_rules() -> Dict[str, str]:
+    """{rule: description} across every registered family."""
+    _load_builtin_checkers()
+    out: Dict[str, str] = {}
+    for _, cls in sorted(_REGISTRY.items()):
+        out.update(cls.rules)
+    return out
+
+
+# ------------------------------------------------------------------ #
+# file collection + run                                               #
+# ------------------------------------------------------------------ #
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def _find_repo_root(start: str) -> str:
+    """Walk up from ``start`` to the first dir holding ``docs/`` or
+    ``.git`` (the baseline + glossary anchor); fall back to start."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    probe = cur
+    while True:
+        if (os.path.isdir(os.path.join(probe, "docs"))
+                or os.path.isdir(os.path.join(probe, ".git"))):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            return cur
+        probe = parent
+
+
+def collect_files(paths: Sequence[str],
+                  repo_root: Optional[str] = None
+                  ) -> Tuple[List[SourceFile], str]:
+    """Parse every ``.py`` under ``paths``. Returns (files, repo_root);
+    ``rel`` paths are relative to the repo root. Unparsable files
+    raise -- a lint that skips syntax errors hides the worst finding."""
+    if repo_root is None:
+        repo_root = _find_repo_root(paths[0] if paths else ".")
+    out: List[SourceFile] = []
+    seen: Set[str] = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            targets = [p]
+        else:
+            targets = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                targets.extend(os.path.join(dirpath, f)
+                               for f in sorted(filenames)
+                               if f.endswith(".py"))
+        for path in targets:
+            if path in seen:
+                continue
+            seen.add(path)
+            rel = os.path.relpath(path, repo_root)
+            with open(path) as f:
+                out.append(SourceFile(path, rel, f.read()))
+    return out, repo_root
+
+
+def run_zoolint(paths: Sequence[str],
+                rules: Optional[Sequence[str]] = None,
+                checkers: Optional[Sequence[Checker]] = None,
+                repo_root: Optional[str] = None) -> List[Finding]:
+    """Run checkers over ``paths``; returns suppression-filtered
+    findings sorted by (path, line, rule). ``rules`` restricts to a
+    subset; ``checkers`` overrides the registry (unit tests)."""
+    files, repo_root = collect_files(paths, repo_root=repo_root)
+    project = Project(files, repo_root=repo_root)
+    if checkers is None:
+        checkers = all_checkers()
+    wanted = set(rules) if rules else None
+    if wanted is not None:
+        # a --rules subset skips whole families, not just their output
+        checkers = [c for c in checkers if wanted & set(c.rules)]
+    findings: List[Finding] = []
+    for checker in checkers:
+        for src in files:
+            findings.extend(checker.check_file(src))
+        findings.extend(checker.check_project(project))
+    kept = []
+    for f in findings:
+        if wanted is not None and f.rule not in wanted:
+            continue
+        src = project.file(f.path)
+        if src is not None and f.line and src.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept

@@ -1,0 +1,71 @@
+"""Checker family 5: exception-handling hygiene.
+
+One rule, born from the crash-observability work: the flight recorder
+exists so failures leave evidence, yet several of its own fallback
+paths swallowed exceptions with ``except Exception: pass`` -- the one
+place evidence-free failure is most corrosive.
+
+``silent-except`` (warning)
+    A handler catching ``Exception`` / ``BaseException`` / bare
+    ``except:`` whose body is only ``pass`` (or ``...``). Narrow the
+    exception type, or at minimum ``logger.debug`` what was swallowed;
+    where a handler genuinely cannot log (interpreter teardown),
+    suppress inline with a rationale comment. Handlers for *narrow*
+    types (``except ValueError: pass``) are deliberate control flow
+    and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from analytics_zoo_tpu.analysis.core import (
+    Checker, Finding, SourceFile, register)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _names_broad(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in _BROAD
+    if isinstance(node, ast.Attribute):  # builtins.Exception
+        return node.attr in _BROAD
+    if isinstance(node, ast.Tuple):
+        return any(_names_broad(e) for e in node.elts)
+    return False
+
+
+def _body_is_silent(body) -> bool:
+    if len(body) != 1:
+        return False
+    stmt = body[0]
+    if isinstance(stmt, ast.Pass):
+        return True
+    return (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+
+
+@register
+class HygieneChecker(Checker):
+    name = "hygiene"
+    rules = {
+        "silent-except": "broad 'except Exception:' (or bare except) "
+                         "whose body is only pass -- failures vanish "
+                         "without evidence",
+    }
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or _names_broad(node.type)
+            if broad and _body_is_silent(node.body):
+                caught = ("bare except" if node.type is None
+                          else "except Exception")
+                yield Finding(
+                    "silent-except", "warning", src.rel, node.lineno,
+                    f"{caught}: pass swallows failures without a "
+                    "trace; narrow the type, debug-log the error, or "
+                    "suppress inline with a rationale")

@@ -1,0 +1,349 @@
+"""Checker family 1: jit/pmap/shard_map trace + concretization hazards.
+
+The static twin of obs.events.RecompileDetector: the runtime detector
+notices a jitted fn compiling N distinct shapes inside a window; these
+rules catch the code patterns that *cause* retraces or trace-time
+errors before they ship.
+
+A function counts as **jitted** when it is
+
+- decorated ``@jax.jit`` / ``@jit`` / ``@jax.pmap`` /
+  ``@partial(jax.jit, ...)`` (any of jit/pmap/shard_map spellings), or
+- passed by name to ``jax.jit(fn, ...)`` / ``jax.shard_map(fn, ...)``
+  anywhere in the same module (the repo's dominant idiom:
+  ``self._step = jax.jit(step)``), or
+- a lambda given directly to one of those wrappers.
+
+Inside a jitted function, its parameters (minus ``static_argnums`` /
+``static_argnames``) are tracers. Rules:
+
+``jit-numpy-call`` (error)
+    ``np.*(...)`` with a tracer-derived argument: numpy concretizes
+    the tracer (ConcretizationTypeError at best, a silently host-
+    computed constant at worst). Use ``jnp``/``lax`` inside traces.
+
+``jit-concretize`` (error)
+    ``.item()`` / ``float()`` / ``int()`` / ``bool()`` on a tracer-
+    derived value: forces a host sync + concrete value mid-trace.
+
+``jit-tracer-branch`` (error)
+    Python ``if``/``while`` on a tracer-derived condition: either a
+    trace error or -- when the value sneaks in via a static argument
+    -- one full retrace *per distinct value*, the exact storm the
+    runtime detector pages on. Shape/dtype/``is None`` conditions are
+    static and exempt.
+
+``jit-static-argnums`` (warning)
+    ``static_argnums``/``static_argnames`` given a list/set/dict
+    display (unhashable; jit's cache key wants an int or tuple of
+    ints) or non-int/str elements.
+
+Tracer-ness is decided by :func:`_is_tracer_expr` -- a conservative
+symbolic walk that treats ``x.shape`` / ``x.ndim`` / ``x.dtype`` /
+``x.size`` / ``len(x)`` / ``isinstance(x, ...)`` / ``x is None`` as
+static (they are, at trace time), so shape-bucketing branches and
+None-gated optional operands do not fire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from analytics_zoo_tpu.analysis.core import (
+    Checker, Finding, SourceFile, register)
+
+_JIT_NAMES = {"jit", "pmap", "shard_map"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_FUNCS = {"len", "isinstance", "type", "hasattr", "range",
+                 "enumerate", "zip"}
+
+
+def _jit_kind(func: ast.expr) -> Optional[str]:
+    """'jit'/'pmap'/'shard_map' when ``func`` names a jit-family
+    wrapper (bare or as ``jax.<name>`` / ``api.<name>``)."""
+    if isinstance(func, ast.Name) and func.id in _JIT_NAMES:
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr in _JIT_NAMES:
+        return func.attr
+    return None
+
+
+def _is_partial(func: ast.expr) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id == "partial"
+    if isinstance(func, ast.Attribute):
+        return func.attr == "partial"
+    return False
+
+
+def _static_params(call: Optional[ast.Call],
+                   fn: ast.AST) -> Set[str]:
+    """Param names made static by static_argnums/static_argnames on
+    the wrapping jit call (best-effort: literal ints/strs only)."""
+    if call is None:
+        return set()
+    args = getattr(fn, "args", None)
+    pos: List[str] = []
+    if args is not None:
+        pos = [a.arg for a in list(args.posonlyargs) + list(args.args)]
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(
+                        c.value, int) and 0 <= c.value < len(pos):
+                    out.add(pos[c.value])
+        elif kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(
+                        c.value, str):
+                    out.add(c.value)
+    return out
+
+
+def _is_tracer_expr(node: ast.AST, params: Set[str]) -> bool:
+    """Conservative 'may hold a tracer at trace time' walk."""
+    if isinstance(node, ast.Name):
+        return node.id in params
+    if isinstance(node, ast.Constant):
+        return False
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False  # x.shape / x.dtype are concrete under trace
+        return _is_tracer_expr(node.value, params)
+    if isinstance(node, ast.Subscript):
+        return _is_tracer_expr(node.value, params)
+    if isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Name) and func.id in _STATIC_FUNCS:
+            return False  # len(x), isinstance(x, ...) are static
+        children = list(node.args) + [kw.value for kw in node.keywords]
+        if isinstance(func, ast.Attribute):
+            children.append(func.value)  # x.astype(...) tracks x
+        return any(_is_tracer_expr(c, params) for c in children)
+    if isinstance(node, ast.Compare):
+        # `x is None` / `x is not None` resolve statically at trace
+        # time (a tracer is never None); other comparators propagate
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+        return (_is_tracer_expr(node.left, params)
+                or any(_is_tracer_expr(c, params)
+                       for c in node.comparators))
+    if isinstance(node, ast.BoolOp):
+        return any(_is_tracer_expr(v, params) for v in node.values)
+    if isinstance(node, ast.BinOp):
+        return (_is_tracer_expr(node.left, params)
+                or _is_tracer_expr(node.right, params))
+    if isinstance(node, ast.UnaryOp):
+        return _is_tracer_expr(node.operand, params)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_is_tracer_expr(e, params) for e in node.elts)
+    if isinstance(node, ast.IfExp):
+        return (_is_tracer_expr(node.body, params)
+                or _is_tracer_expr(node.orelse, params))
+    return False
+
+
+def _np_root(func: ast.expr) -> Optional[str]:
+    """'np'/'numpy'/'onp' when ``func`` is an attribute chain rooted
+    at a host-numpy module alias."""
+    node = func
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name) and node.id in ("np", "numpy", "onp"):
+        return node.id
+    return None
+
+
+class _JittedFn:
+    def __init__(self, fn: ast.AST, kind: str,
+                 call: Optional[ast.Call]):
+        self.fn = fn
+        self.kind = kind
+        self.name = getattr(fn, "name", "<lambda>")
+        args = getattr(fn, "args", None)
+        names: Set[str] = set()
+        if args is not None:
+            for a in (list(args.posonlyargs) + list(args.args)
+                      + list(args.kwonlyargs)):
+                names.add(a.arg)
+        self.params = names - _static_params(call, fn)
+
+
+@register
+class TraceHazardChecker(Checker):
+    name = "trace"
+    rules = {
+        "jit-numpy-call": "host numpy call on a traced value inside a "
+                          "jitted function (use jnp/lax)",
+        "jit-concretize": ".item()/float()/int()/bool() on a traced "
+                          "value inside a jitted function",
+        "jit-tracer-branch": "Python if/while on a traced value inside "
+                             "a jitted function (retrace or trace "
+                             "error; use lax.cond/jnp.where)",
+        "jit-static-argnums": "static_argnums/static_argnames should "
+                              "be an int/str or tuple literal "
+                              "(lists/sets/dicts are unhashable cache "
+                              "keys)",
+    }
+
+    # ------------------------------------------------------ discovery --
+    def _jitted_functions(self, src: SourceFile) -> List[_JittedFn]:
+        tree = src.tree
+        # pass 1: names (and lambdas) handed to jit-family wrappers
+        wrapped: Dict[str, ast.Call] = {}
+        lambdas: List[Tuple[ast.Lambda, str, ast.Call]] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _jit_kind(node.func)
+            if kind is None or not node.args:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                wrapped[target.id] = node
+            elif isinstance(target, ast.Lambda):
+                lambdas.append((target, kind, node))
+        # pass 2: decorated defs + defs matching a wrapped name
+        out: List[_JittedFn] = []
+        claimed: Set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            deco_call: Optional[ast.Call] = None
+            kind: Optional[str] = None
+            for deco in node.decorator_list:
+                k = _jit_kind(deco)
+                if k is None and isinstance(deco, ast.Call):
+                    k = _jit_kind(deco.func)
+                    if k is not None:
+                        deco_call = deco
+                    elif _is_partial(deco.func) and deco.args:
+                        k = _jit_kind(deco.args[0])
+                        if k is not None:
+                            deco_call = deco
+                if k is not None:
+                    kind = k
+                    break
+            if kind is None and node.name in wrapped:
+                kind = _jit_kind(wrapped[node.name].func) or "jit"
+                deco_call = wrapped[node.name]
+            if kind is not None and id(node) not in claimed:
+                claimed.add(id(node))
+                out.append(_JittedFn(node, kind, deco_call))
+        for lam, kind, call in lambdas:
+            out.append(_JittedFn(lam, kind, call))
+        return out
+
+    # ------------------------------------------------------- per rule --
+    def _check_body(self, src: SourceFile,
+                    jf: _JittedFn) -> Iterable[Finding]:
+        params = jf.params
+        body = (jf.fn.body if isinstance(jf.fn.body, list)
+                else [jf.fn.body])
+        for stmt in body:
+            for node in ast.walk(stmt):
+                # nested defs re-bind their own params; a shadowing
+                # inner fn is rare enough that the conservative shared
+                # param set is acceptable
+                if isinstance(node, ast.Call):
+                    root = _np_root(node.func)
+                    if root is not None and any(
+                            _is_tracer_expr(a, params)
+                            for a in list(node.args)
+                            + [kw.value for kw in node.keywords]):
+                        yield Finding(
+                            "jit-numpy-call", "error", src.rel,
+                            node.lineno,
+                            f"{jf.kind}-traced function "
+                            f"'{jf.name}' calls host numpy "
+                            f"({root}.{self._attr_chain(node.func)}) "
+                            "on a traced value; use jnp/lax so the op "
+                            "stays in the XLA program")
+                        continue
+                    if (isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "item"
+                            and not node.args
+                            and _is_tracer_expr(node.func.value,
+                                                params)):
+                        yield Finding(
+                            "jit-concretize", "error", src.rel,
+                            node.lineno,
+                            f"{jf.kind}-traced function "
+                            f"'{jf.name}' calls .item() on a traced "
+                            "value (host sync + concretization inside "
+                            "the trace)")
+                        continue
+                    if (isinstance(node.func, ast.Name)
+                            and node.func.id in ("float", "int", "bool")
+                            and len(node.args) == 1
+                            and _is_tracer_expr(node.args[0], params)):
+                        yield Finding(
+                            "jit-concretize", "error", src.rel,
+                            node.lineno,
+                            f"{jf.kind}-traced function "
+                            f"'{jf.name}' applies "
+                            f"{node.func.id}() to a traced value "
+                            "(ConcretizationTypeError under jit)")
+                elif isinstance(node, (ast.If, ast.While)):
+                    if _is_tracer_expr(node.test, params):
+                        kw = ("if" if isinstance(node, ast.If)
+                              else "while")
+                        yield Finding(
+                            "jit-tracer-branch", "error", src.rel,
+                            node.lineno,
+                            f"{jf.kind}-traced function "
+                            f"'{jf.name}' branches with Python "
+                            f"'{kw}' on a traced value; use lax.cond/"
+                            "lax.while_loop or jnp.where (a static "
+                            "operand here means one retrace per "
+                            "distinct value -- the recompile-storm "
+                            "pattern)")
+
+    @staticmethod
+    def _attr_chain(func: ast.expr) -> str:
+        parts: List[str] = []
+        node = func
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        return ".".join(reversed(parts)) or "?"
+
+    def _check_static_argnums(self, src: SourceFile
+                              ) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_jit = _jit_kind(node.func) is not None or (
+                _is_partial(node.func) and node.args
+                and _jit_kind(node.args[0]) is not None)
+            if not is_jit:
+                continue
+            for kw in node.keywords:
+                if kw.arg not in ("static_argnums", "static_argnames"):
+                    continue
+                bad = None
+                if isinstance(kw.value, (ast.List, ast.Set,
+                                         ast.Dict)):
+                    bad = type(kw.value).__name__.lower()
+                elif isinstance(kw.value, ast.Tuple):
+                    ok = (int if kw.arg == "static_argnums" else str)
+                    if any(not (isinstance(e, ast.Constant)
+                                and isinstance(e.value, ok))
+                           for e in kw.value.elts):
+                        bad = "tuple with non-literal elements"
+                if bad:
+                    yield Finding(
+                        "jit-static-argnums", "warning", src.rel,
+                        kw.value.lineno,
+                        f"{kw.arg} given a {bad}; jit's cache key "
+                        "needs a hashable int/str or tuple of "
+                        "literals")
+
+    # --------------------------------------------------------- driver --
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for jf in self._jitted_functions(src):
+            yield from self._check_body(src, jf)
+        yield from self._check_static_argnums(src)

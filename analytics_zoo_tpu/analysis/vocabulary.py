@@ -1,0 +1,172 @@
+"""Checker family 4: metric-name + event-type vocabulary enforcement.
+
+The naming rules themselves live where they always did -- the
+``zoo_<subsystem>_<name>_<unit>`` convention in
+``obs.metrics.check_metric_name`` and the ``EVENT_TYPES`` registry in
+``obs.events`` -- this checker is the *scanner* half, migrated from
+the hand-rolled walkers in ``tests/test_metric_names.py`` so every
+naming rule reports through one framework (same suppression, same
+baseline, same CLI). The test file remains as thin wrappers over
+:func:`collect_registrations` / :func:`collect_emissions`, keeping
+its assertions alive.
+
+``metric-name`` (error)
+    A literal registry registration (``<reg>.counter/gauge/
+    histogram("...")``) whose name breaks the convention.
+
+``metric-collision`` (error)
+    One metric family registered from more than one module: help
+    text, labels, and ownership fragment. Register once, import the
+    family object.
+
+``event-type`` (error)
+    A literal ``emit("<type>", ...)`` whose type is not
+    lower_snake_case or not registered in ``obs.events.EVENT_TYPES``.
+
+``event-vocab-module`` (error)
+    ``EVENT_TYPES`` assigned outside ``obs/events.py`` -- a second
+    vocabulary module would fragment the event namespace exactly the
+    way cross-module metric registration fragments families.
+
+Registry-receiver heuristic (unchanged from the test it replaces): a
+bare name containing ``reg`` or a direct ``get_registry().x(...)``
+chain counts; the per-instance Timer API (``self.timer.gauge``) does
+not -- sampled local stats are not registry families.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from analytics_zoo_tpu.analysis.core import (
+    Checker, Finding, Project, register)
+
+_REGISTER_METHODS = ("counter", "gauge", "histogram")
+_EVENTS_REL_SUFFIX = "obs/events.py"
+
+
+def _is_registry_receiver(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return "reg" in node.id.lower()
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id == "get_registry"
+    return False
+
+
+def _is_emit_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in ("emit", "emit_event")
+    if isinstance(func, ast.Attribute):
+        return func.attr == "emit"
+    return False
+
+
+def collect_registrations(project: Project
+                          ) -> List[Tuple[str, str, str, int]]:
+    """(module_rel, kind, name, line) for every literal-name registry
+    registration in the project."""
+    found = []
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTER_METHODS
+                    and _is_registry_receiver(node.func.value)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                found.append((src.rel, node.func.attr,
+                              node.args[0].value, node.lineno))
+    return found
+
+
+def collect_emissions(project: Project
+                      ) -> List[Tuple[str, str, int]]:
+    """(module_rel, event_type, line) for every literal-type emit call
+    in the project."""
+    found = []
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call) and _is_emit_call(node)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                found.append((src.rel, node.args[0].value,
+                              node.lineno))
+    return found
+
+
+def collect_vocab_owners(project: Project) -> List[Tuple[str, int]]:
+    """(module_rel, line) for every module assigning EVENT_TYPES."""
+    owners = []
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "EVENT_TYPES":
+                    owners.append((src.rel, node.lineno))
+    return owners
+
+
+@register
+class VocabularyChecker(Checker):
+    name = "vocabulary"
+    rules = {
+        "metric-name": "registered metric name breaks the "
+                       "zoo_<subsystem>_<name>_<unit> convention",
+        "metric-collision": "one metric family registered from "
+                            "multiple modules",
+        "event-type": "emitted event type not lower_snake_case or "
+                      "not registered in obs.events.EVENT_TYPES",
+        "event-vocab-module": "EVENT_TYPES assigned outside "
+                              "obs/events.py (one vocabulary module)",
+    }
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        # the conventions live in obs; import lazily so the engine
+        # itself stays importable in stripped-down fixture runs
+        from analytics_zoo_tpu.obs.events import check_event_type
+        from analytics_zoo_tpu.obs.metrics import check_metric_name
+
+        regs = collect_registrations(project)
+        for rel, kind, mname, line in regs:
+            try:
+                check_metric_name(mname, kind)
+            except ValueError as e:
+                yield Finding("metric-name", "error", rel, line,
+                              str(e))
+        owners: Dict[str, Set[str]] = {}
+        first_site: Dict[str, Tuple[str, int]] = {}
+        for rel, _kind, mname, line in regs:
+            owners.setdefault(mname, set()).add(rel)
+            first_site.setdefault(mname, (rel, line))
+        for mname, mods in sorted(owners.items()):
+            if len(mods) > 1:
+                rel, line = first_site[mname]
+                yield Finding(
+                    "metric-collision", "error", rel, line,
+                    f"metric family '{mname}' registered from "
+                    f"{len(mods)} modules ({', '.join(sorted(mods))});"
+                    " move the registration to one owner and import "
+                    "the family")
+
+        for rel, etype, line in collect_emissions(project):
+            try:
+                check_event_type(etype)
+            except ValueError as e:
+                yield Finding("event-type", "error", rel, line,
+                              str(e))
+
+        for rel, line in collect_vocab_owners(project):
+            if not rel.endswith(_EVENTS_REL_SUFFIX):
+                yield Finding(
+                    "event-vocab-module", "error", rel, line,
+                    "EVENT_TYPES assigned outside obs/events.py; the "
+                    "event vocabulary has exactly one home (use "
+                    "obs.events.register_event_type to extend it)")

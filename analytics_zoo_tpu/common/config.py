@@ -29,7 +29,9 @@ _DEFAULTS: Dict[str, Any] = {
     # ~23 ms/step on BERT-base b32/L384 v5e (MFU 0.35 -> 0.42 measured)
     # -- and threefry elsewhere; set explicitly to pin an impl
     "zoo.train.prng_impl": "auto",
-    # mesh / parallelism axis names
+    # mesh / parallelism axis names -- read through
+    # parallel.mesh.config_axis("<role>") (a prefix-built key, so
+    # grep for the wrapper, not the literal)
     "zoo.mesh.axis.data": "data",
     "zoo.mesh.axis.model": "model",
     "zoo.mesh.axis.sequence": "seq",
@@ -73,7 +75,10 @@ _DEFAULTS: Dict[str, Any] = {
     # batches in flight; false restores the synchronous per-batch loop
     "zoo.serving.pipeline.enabled": True,
     "zoo.serving.pipeline.depth": 2,
-    "zoo.serving.http_port": 10020,
+    # launcher default when the YAML omits http.port; 0 = pick a free
+    # port (the reference FrontEndApp pinned 10020 -- set that here to
+    # reproduce its behavior)
+    "zoo.serving.http_port": 0,
     # observability (analytics_zoo_tpu.obs): per-request tracing gate
     # (spans ride queue blobs as __trace__ and export as Chrome trace
     # JSON; off by default -- the disabled path must cost nothing),

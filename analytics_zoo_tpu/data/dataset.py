@@ -29,6 +29,7 @@ from typing import Any, Callable, Iterator, Optional, Tuple
 import jax
 import numpy as np
 
+from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
@@ -241,10 +242,18 @@ class ZooDataset:
 
             n_data = mesh_axis_size(mesh, "data")
         if batch_size % max(n_data, 1) != 0:
-            raise ValueError(
-                f"global batch_size {batch_size} must be divisible by the "
-                f"data-parallel degree {n_data} "
-                "(ref contract: tf_dataset.py:142-147)")
+            # opt-out knob (zoo.data.check_batch_divisible) for callers
+            # that shard manually; with the check off, XLA raises later
+            # at placement instead of here with a readable message
+            if get_config().get("zoo.data.check_batch_divisible", True):
+                raise ValueError(
+                    f"global batch_size {batch_size} must be divisible "
+                    f"by the data-parallel degree {n_data} "
+                    "(ref contract: tf_dataset.py:142-147)")
+            logger.warning(
+                "batch_size %d is not divisible by the data-parallel "
+                "degree %d (zoo.data.check_batch_divisible is off)",
+                batch_size, n_data)
 
         n_proc = jax.process_count()
         proc = jax.process_index()
@@ -286,13 +295,18 @@ class ZooDataset:
     def device_iterator(self, batch_size: int, mesh=None, shuffle: bool = True,
                         seed: int = 0, epoch: int = 0,
                         drop_remainder: bool = True, with_mask: bool = False,
-                        prefetch: int = 2) -> Iterator[Tuple[Any, ...]]:
+                        prefetch: Optional[int] = None
+                        ) -> Iterator[Tuple[Any, ...]]:
         """``batches`` + mesh placement + background prefetch.
 
-        A producer thread stages the next ``prefetch`` device batches while
+        A producer thread stages the next ``prefetch`` device batches
+        (default: the ``zoo.data.prefetch_buffer`` config key) while
         the consumer runs the train step -- the analog of FeatureSet's
         cached-RDD prefetch, but across the host->HBM boundary.
         """
+        if prefetch is None:
+            prefetch = int(get_config().get("zoo.data.prefetch_buffer",
+                                            2))
         from analytics_zoo_tpu.parallel.mesh import default_mesh
         from analytics_zoo_tpu.parallel.sharding import shard_batch
 

@@ -77,6 +77,13 @@ class InferenceModel:
         # concurrent_num kept for API parity (ref: InferenceModel.scala
         # concurrentNum); XLA needs no model copies.
         self.concurrent_num = concurrent_num
+        if dtype is None:
+            # advisory serving dtype (importers/quantize consult it);
+            # the zoo.inference.default_dtype key, bfloat16 on TPU
+            from analytics_zoo_tpu.common.config import get_config
+
+            dtype = str(get_config().get("zoo.inference.default_dtype",
+                                         "bfloat16"))
         self.dtype = dtype
         from analytics_zoo_tpu.common.context import (
             enable_compilation_cache)

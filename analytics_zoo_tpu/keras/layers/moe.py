@@ -43,6 +43,17 @@ import jax.numpy as jnp
 from analytics_zoo_tpu.keras.activations import get as get_activation
 from analytics_zoo_tpu.keras.layers.base import KerasLayer
 
+
+def resolve_expert_axis(value: Optional[str]) -> Optional[str]:
+    """``"auto"`` -> the ``zoo.mesh.axis.expert`` config key; any other
+    value (an explicit axis name, or None for the dense path) passes
+    through unchanged."""
+    if value == "auto":
+        from analytics_zoo_tpu.parallel.mesh import config_axis
+
+        return config_axis("expert")
+    return value
+
 __all__ = ["MoEFFN", "MoE", "MoETransformerBlock"]
 
 
@@ -54,7 +65,8 @@ class MoEFFN(nn.Module):
       n_experts: expert count; must divide by the expert-axis size
         when expert parallelism engages.
       top_k: experts per token (1 = switch routing, 2 = classic MoE).
-      expert_axis: mesh axis name to shard experts over; engages when
+      expert_axis: mesh axis name to shard experts over ("auto" reads
+        the ``zoo.mesh.axis.expert`` config key); engages when
         the context mesh carries that axis with size > 1 dividing
         ``n_experts``. None = always dense.
       layout: "broadcast" (exact, shards memory only) or "dispatch"
@@ -141,13 +153,14 @@ class MoEFFN(nn.Module):
 
         ep_size = 0
         mesh = None
-        if self.expert_axis is not None:
+        expert_axis = resolve_expert_axis(self.expert_axis)
+        if expert_axis is not None:
             from analytics_zoo_tpu.parallel.mesh import (
                 default_mesh, mesh_axis_size)
 
             mesh = default_mesh()
-            if self.expert_axis in mesh.axis_names:
-                ep_size = mesh_axis_size(mesh, self.expert_axis)
+            if expert_axis in mesh.axis_names:
+                ep_size = mesh_axis_size(mesh, expert_axis)
         if ep_size > 1 and e % ep_size == 0 \
                 and self.layout == "dispatch" \
                 and not self.is_initializing():
@@ -159,7 +172,7 @@ class MoEFFN(nn.Module):
         elif ep_size > 1 and e % ep_size == 0:
             from jax.sharding import PartitionSpec as P
 
-            axis = self.expert_axis
+            axis = expert_axis
             # batch stays sharded over the data axis (dp x ep): each
             # device computes local_batch x local_experts, the psum
             # runs over the expert axis only
@@ -202,7 +215,7 @@ class MoEFFN(nn.Module):
 
         from analytics_zoo_tpu.parallel.mesh import mesh_axis_size
 
-        axis = self.expert_axis
+        axis = resolve_expert_axis(self.expert_axis)
         e, k = self.n_experts, self.top_k
         e_loc = e // ep_size
         data = ("data" if "data" in mesh.axis_names

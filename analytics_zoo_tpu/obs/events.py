@@ -148,8 +148,13 @@ def to_jsonable(v: Any) -> Any:
     if item is not None:
         try:
             return item()
-        except Exception:
-            pass
+        except Exception as e:
+            # non-scalar .item() (size != 1 array) or a lazy backend
+            # refusing the sync: fall through to str(), but leave a
+            # trace -- a coercion path that fails silently hides the
+            # exact field the postmortem reader needed
+            logger.debug("to_jsonable: .item() on %s failed: %s",
+                         type(v).__name__, e)
     return str(v)
 
 

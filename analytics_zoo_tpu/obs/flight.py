@@ -192,8 +192,12 @@ class FlightRecorder:
         try:
             log.emit("postmortem_written", "obs", path=path,
                      reason=reason)
-        except Exception:
-            pass
+        except Exception as e:
+            # the bundle on disk is already complete; only the event-
+            # ring echo failed (e.g. a broken metrics backend mid-
+            # crash). Log it: a crash-reporting path must not itself
+            # fail without evidence
+            logger.debug("postmortem_written event emit failed: %s", e)
         logger.error("postmortem bundle written: %s (%s)", path, reason)
         return path
 
@@ -322,8 +326,11 @@ class FlightRecorder:
                 else:
                     faulthandler.disable()
                 self._fault_file.close()
-            except Exception:
-                pass
+            except Exception as e:
+                # uninstall() must not raise (tests tear down in
+                # finally blocks), but a faulthandler left half-
+                # restored is worth a breadcrumb
+                logger.debug("faulthandler restore failed: %s", e)
             self._fault_file = None
         self._installed = False
 

@@ -127,8 +127,12 @@ class Reporter:
                 from analytics_zoo_tpu.obs.events import emit
 
                 emit("reporter_final", "obs", rollup=line[:500])
-            except Exception:  # interpreter teardown half-way through
-                pass
+            except Exception as e:
+                # atexit path: interpreter teardown may have dismantled
+                # the registry/event log under us. The logging module
+                # shuts down after atexit hooks run (its own hook was
+                # registered first, LIFO), so a debug line is still safe
+                self._log.debug("final rollup flush failed: %s", e)
 
 
 def maybe_start_reporter() -> Optional[Reporter]:

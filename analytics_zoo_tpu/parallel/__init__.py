@@ -17,6 +17,7 @@ XLA collectives over ICI/DCN, driven by ``jax.sharding.Mesh`` +
 """
 
 from analytics_zoo_tpu.parallel.mesh import (  # noqa: F401
+    config_axis,
     create_mesh,
     default_mesh,
     mesh_axis_size,

@@ -118,3 +118,19 @@ def mesh_axis_size(mesh: Mesh, name: str) -> int:
     if name not in mesh.axis_names:
         return 1
     return mesh.devices.shape[mesh.axis_names.index(name)]
+
+
+def config_axis(role: str, fallback: Optional[str] = None) -> str:
+    """Canonical mesh-axis name for a parallelism *role* -- the
+    ``zoo.mesh.axis.<role>`` config family (roles: data, model,
+    sequence, pipeline, expert). Call sites take an ``axis`` argument
+    and default it through here, so a deployment that renames an axis
+    (e.g. a hybrid mesh calling its tensor axis ``"tp"``) sets one
+    config key instead of threading the name through every recipe.
+    Unknown roles fall back to ``fallback`` (default: the role
+    itself)."""
+    from analytics_zoo_tpu.common.config import get_config
+
+    return str(get_config().get("zoo.mesh.axis." + role,
+                                fallback if fallback is not None
+                                else role))

@@ -25,24 +25,28 @@ parameter names this matches by suffix.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Callable, Optional
 
 from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu.parallel.mesh import config_axis
 
 
 def _path_name(path) -> str:
     return "/".join(str(getattr(k, "key", k)) for k in path).lower()
 
 
-def transformer_tp_spec(axis: str = "model",
+def transformer_tp_spec(axis: Optional[str] = None,
                         shard_embeddings: bool = True) -> Callable:
-    """``param_spec_fn`` sharding transformer blocks over ``axis``.
+    """``param_spec_fn`` sharding transformer blocks over ``axis``
+    (default: the ``zoo.mesh.axis.model`` config key -> ``"model"``).
 
     Pass to ``Estimator(param_spec_fn=transformer_tp_spec())`` together
     with a mesh carrying a model axis, e.g.
     ``create_mesh({"data": 2, "model": 4})``. Composes with data
     parallelism (the batch shards over the data axis independently).
     """
+    axis = axis if axis is not None else config_axis("model")
 
     def spec(path, leaf) -> P:
         name = _path_name(path)
@@ -73,9 +77,11 @@ def transformer_tp_spec(axis: str = "model",
     return spec
 
 
-def embedding_tp_spec(axis: str = "model") -> Callable:
+def embedding_tp_spec(axis: Optional[str] = None) -> Callable:
     """``param_spec_fn`` sharding only embedding tables (the recommender
-    recipe: MLP stays replicated, the big tables split over ``axis``)."""
+    recipe: MLP stays replicated, the big tables split over ``axis``,
+    default ``zoo.mesh.axis.model``)."""
+    axis = axis if axis is not None else config_axis("model")
 
     def spec(path, leaf) -> P:
         name = _path_name(path)
@@ -86,9 +92,12 @@ def embedding_tp_spec(axis: str = "model") -> Callable:
     return spec
 
 
-def pipeline_stage_spec(axis: str = "pipe") -> Callable:
+def pipeline_stage_spec(axis: Optional[str] = None) -> Callable:
     """``param_spec_fn`` for stacked-stage parameters (leading dim =
-    pipeline stage, as produced by ``parallel.staged`` models)."""
+    pipeline stage, as produced by ``parallel.staged`` models; default
+    axis name from ``zoo.mesh.axis.pipeline`` -> ``"pipe"``)."""
+    axis = axis if axis is not None else config_axis("pipeline",
+                                                     fallback="pipe")
 
     def spec(path, leaf) -> P:
         name = _path_name(path)

@@ -30,6 +30,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from analytics_zoo_tpu.parallel.mesh import config_axis
+
 NEG_INF = -1e30
 
 
@@ -153,11 +155,13 @@ def _ring_shard_call(local_fn, q, k, v, mesh, axis_name, qkv_spec,
     return fn(q, k, v, *extra)
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
+def ring_attention(q, k, v, mesh: Mesh,
+                   axis_name: Optional[str] = None,
                    causal: bool = False, scale: Optional[float] = None,
                    qkv_spec: Optional[P] = None,
                    dropout_rate: float = 0.0, dropout_rng=None):
-    """Exact attention with sequence dim sharded over ``axis_name``.
+    """Exact attention with sequence dim sharded over ``axis_name``
+    (default: the ``zoo.mesh.axis.sequence`` config key -> ``"seq"``).
 
     Args:
       q, k, v: [batch, seq, heads, head_dim] (global arrays or to-be-sharded
@@ -171,13 +175,16 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
         so the ring schedule applies exact elementwise prob dropout
         (see ``_block_attn``). Pass a key only when training.
     """
+    if axis_name is None:
+        axis_name = config_axis("sequence", fallback="seq")
     return _ring_shard_call(_ring_attn_local, q, k, v, mesh,
                             axis_name, qkv_spec, dropout_rate,
                             dropout_rng, causal=causal, scale=scale)
 
 
 def ring_self_attention(x, wq, wk, wv, wo, num_heads: int, mesh: Mesh,
-                        axis_name: str = "seq", causal: bool = False):
+                        axis_name: Optional[str] = None,
+                        causal: bool = False):
     """Convenience: project -> ring attention -> output projection.
 
     x: [batch, seq, dim]; w*: [dim, dim]. Projections are local (sequence
@@ -310,7 +317,8 @@ def _zigzag_local(q, k, v, rng, axis_name: str, scale: Optional[float],
     return out.astype(q.dtype)
 
 
-def zigzag_ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
+def zigzag_ring_attention(q, k, v, mesh: Mesh,
+                          axis_name: Optional[str] = None,
                           scale: Optional[float] = None,
                           qkv_spec: Optional[P] = None,
                           dropout_rate: float = 0.0, dropout_rng=None,
@@ -333,6 +341,8 @@ def zigzag_ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
     Non-causal attention has no masked tiles to skip; use
     :func:`ring_attention` there.
     """
+    if axis_name is None:
+        axis_name = config_axis("sequence", fallback="seq")
     n_dev = mesh.shape[axis_name]
     seq_len = q.shape[1]
     perm, inv = _zigzag_chunk_perm(seq_len, n_dev)

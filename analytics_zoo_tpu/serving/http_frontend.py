@@ -454,8 +454,10 @@ class HttpFrontend:
 
             out["build"]["jax"] = jax.__version__
             out["build"]["backend"] = jax.default_backend()
-        except Exception:  # jax-free frontend processes stay served
-            pass
+        except Exception as e:
+            # jax-free frontend processes stay served; the debug page
+            # just omits the backend block (but says why in the log)
+            logger.debug("debug endpoint: jax info unavailable: %s", e)
         return out
 
     def health(self):

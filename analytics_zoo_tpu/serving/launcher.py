@@ -193,9 +193,14 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     reporter = None
     try:
         if http.get("enabled", True):
+            port = http.get("port")
+            if port is None:
+                # zoo.serving.http_port (0 = pick a free port); the
+                # YAML's http.port wins when present
+                port = int(get_config().get("zoo.serving.http_port", 0))
             frontend = HttpFrontend(
                 in_q, out_q, host=http.get("host", "127.0.0.1"),
-                port=http.get("port", 0), worker=worker,
+                port=port, worker=worker,
                 certfile=http.get("certfile"),
                 keyfile=http.get("keyfile")).start()
             logger.info("serving ready at %s", frontend.address)

@@ -797,7 +797,10 @@ class ServingWorker:
         }
         try:
             pipe["queue_depth"] = len(self._in)
-        except Exception:
+        except (TypeError, OSError):
+            # a queue backend without __len__ (or a broker hop that
+            # cannot answer right now): depth is best-effort metadata,
+            # omit the field rather than fail the metrics call
             pass
         return {"served": self.served, "stages": self.timer.summary(),
                 "pipeline": pipe}

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""zoolint CLI: run the analytics_zoo_tpu.analysis checkers.
+
+Usage:
+    python scripts/zoolint.py [paths ...]          # default: analytics_zoo_tpu
+    python scripts/zoolint.py --json analytics_zoo_tpu
+    python scripts/zoolint.py --baseline zoolint_baseline.json pkg/
+    python scripts/zoolint.py --update-baseline    # grandfather current findings
+    python scripts/zoolint.py --list-rules
+    python scripts/zoolint.py --rules silent-except,lock-guard pkg/
+
+Exit status: 0 when every finding is baselined (or there are none);
+1 when any NEW finding exists; 2 on usage errors. The tier-1 test
+``tests/test_zoolint.py`` enforces the same contract in CI.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "zoolint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="zoolint",
+        description="repo-native static analysis: jit/trace hazards, "
+                    "serving concurrency, config-key drift, "
+                    "metric/event vocabulary, exception hygiene")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the "
+                         "analytics_zoo_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline json of grandfathered findings "
+                         "(default: zoolint_baseline.json at the repo "
+                         "root, when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline; every finding is new")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(rationales for surviving entries are kept)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset to run")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    from analytics_zoo_tpu.analysis import all_rules, run_zoolint
+    from analytics_zoo_tpu.analysis.baseline import (
+        load_baseline, new_findings, stale_entries, write_baseline)
+
+    if args.list_rules:
+        for rule, desc in sorted(all_rules().items()):
+            print(f"{rule:22s} {desc}")
+        return 0
+
+    if args.update_baseline and args.rules:
+        # a filtered run sees only a slice of the findings; rewriting
+        # the baseline from it would silently drop every grandfathered
+        # entry (and rationale) outside the slice
+        print("zoolint: --update-baseline requires a full-rule run "
+              "(drop --rules)", file=sys.stderr)
+        return 2
+
+    paths = args.paths or [os.path.join(REPO, "analytics_zoo_tpu")]
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    if rules:
+        unknown = set(rules) - set(all_rules())
+        if unknown:
+            print(f"zoolint: unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+    findings = run_zoolint(paths, rules=rules)
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        baseline_path = (DEFAULT_BASELINE
+                         if os.path.isfile(DEFAULT_BASELINE) else None)
+    if args.no_baseline:
+        baseline_path = None
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+
+    if args.update_baseline:
+        out_path = args.baseline or DEFAULT_BASELINE
+        n = write_baseline(findings, out_path, baseline)
+        print(f"zoolint: baseline written: {out_path} ({n} findings; "
+              "fill in a rationale for each new entry)")
+        return 0
+
+    fresh = new_findings(findings, baseline)
+    stale = stale_entries(findings, baseline) if baseline else []
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in fresh],
+            "stale_baseline": stale,
+            "counts": {"total": len(findings), "new": len(fresh),
+                       "baselined": len(findings) - len(fresh),
+                       "stale_baseline": len(stale)},
+        }, indent=2, sort_keys=True))
+        return 1 if fresh else 0
+
+    for f in findings:
+        mark = "" if f.key() in baseline else " (new)"
+        print(f.render() + mark)
+    for e in stale:
+        print(f"stale baseline entry (finding no longer fires -- run "
+              f"--update-baseline): [{e['rule']}] {e['path']}: "
+              f"{e['message']}")
+    print(f"zoolint: {len(findings)} finding(s), {len(fresh)} new, "
+          f"{len(findings) - len(fresh)} baselined, "
+          f"{len(stale)} stale baseline entr(y/ies)")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

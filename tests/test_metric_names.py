@@ -1,84 +1,47 @@
 """Metric-name and event-type convention lint (ISSUE-2/ISSUE-3
-satellites).
+satellites, scanners migrated to the zoolint framework in ISSUE-4).
 
-Walks every module in ``analytics_zoo_tpu`` for registry registrations
--- ``<obj>.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
-with a literal name -- and fails on names that break the
-``zoo_<subsystem>_<name>_<unit>`` convention or collide across modules
-(two modules registering the same family fragments ownership: help
-text, labels, and the lint's module attribution all become ambiguous;
-share the family object instead).
+The hand-rolled AST walkers this file used to carry now live in
+``analytics_zoo_tpu.analysis.vocabulary`` (same registry-receiver and
+emit-call heuristics, same rules) where they run under the full
+zoolint engine -- suppression comments, baseline, CLI. These tests
+are kept as thin wrappers over the checker's collectors so the
+original assertions stay alive:
 
-The same walk covers the structured event log: every literal
-``emit("<type>", ...)`` in the package must use a lower_snake_case
-type registered in ``obs.events.EVENT_TYPES`` -- the ONE vocabulary
-module -- so the event stream stays as disciplined as the metric
-namespace (an inline-invented type would never be documented,
-filtered, or postmortem-greppable).
+- the walkers still *find* the known families/emissions (an empty
+  scan would vacuously pass),
+- every found name/type still passes the convention check,
+- cross-module collisions and second vocabulary modules still fail.
 
-Pytest-collected so the conventions are CI, not a wiki page.
+Full-suite enforcement (all four zoolint families, not just
+vocabulary) lives in ``tests/test_zoolint.py``.
 """
 
-import ast
 import os
-from typing import Dict, List, Tuple
 
-from analytics_zoo_tpu.obs.events import (
-    EVENT_TYPE_RE, EVENT_TYPES, check_event_type)
-from analytics_zoo_tpu.obs.metrics import check_metric_name
+from analytics_zoo_tpu.analysis.core import Project, collect_files
+from analytics_zoo_tpu.analysis.vocabulary import (
+    VocabularyChecker, collect_emissions, collect_registrations,
+    collect_vocab_owners)
+from analytics_zoo_tpu.obs.events import EVENT_TYPE_RE, EVENT_TYPES
 
-PACKAGE = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "analytics_zoo_tpu")
-
-_REGISTER_METHODS = ("counter", "gauge", "histogram")
-
-
-def _is_registry_receiver(node: ast.AST) -> bool:
-    """Only calls on a *registry* count as registrations: a bare name
-    containing "reg" (``_REG``, ``registry``) or a direct
-    ``get_registry().x(...)`` chain. This keeps the per-instance Timer
-    API (``self.timer.gauge("queue_depth", v)``) -- sampled local
-    stats, not registry families -- out of the lint's scope."""
-    if isinstance(node, ast.Name):
-        return "reg" in node.id.lower()
-    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
-        return node.func.id == "get_registry"
-    return False
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "analytics_zoo_tpu")
 
 
-def _registrations() -> List[Tuple[str, str, str]]:
-    """(module, kind, name) for every literal-name registration call
-    in the package source."""
-    found = []
-    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            module = os.path.relpath(path, os.path.dirname(PACKAGE))
-            with open(path) as f:
-                try:
-                    tree = ast.parse(f.read(), filename=path)
-                except SyntaxError as e:  # lint must name the file
-                    raise AssertionError(f"unparsable {module}: {e}")
-            for node in ast.walk(tree):
-                if not (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Attribute)
-                        and node.func.attr in _REGISTER_METHODS
-                        and _is_registry_receiver(node.func.value)
-                        and node.args
-                        and isinstance(node.args[0], ast.Constant)
-                        and isinstance(node.args[0].value, str)):
-                    continue
-                found.append((module, node.func.attr,
-                              node.args[0].value))
-    return found
+def _project() -> Project:
+    files, root = collect_files([PACKAGE], repo_root=REPO)
+    return Project(files, repo_root=root)
+
+
+def _vocab_findings():
+    return list(VocabularyChecker().check_project(_project()))
 
 
 def test_package_registers_metrics():
     """The walker works: the known serving/inference/learn families
     are all found (an empty scan would vacuously pass the lint)."""
-    names = {name for _, _, name in _registrations()}
+    names = {name for _, _, name, _ in collect_registrations(_project())}
     for expected in ("zoo_serving_requests_total",
                      "zoo_serving_stage_duration_seconds",
                      "zoo_serving_batch_close_total",
@@ -90,66 +53,27 @@ def test_package_registers_metrics():
 
 
 def test_metric_names_follow_convention():
-    bad = []
-    for module, kind, name in _registrations():
-        try:
-            check_metric_name(name, kind)
-        except ValueError as e:
-            bad.append(f"{module}: {e}")
+    bad = [f.render() for f in _vocab_findings()
+           if f.rule == "metric-name"]
     assert not bad, "metric naming violations:\n" + "\n".join(bad)
 
 
 def test_no_cross_module_collisions():
-    owners: Dict[str, set] = {}
-    for module, _kind, name in _registrations():
-        owners.setdefault(name, set()).add(module)
-    collisions = {name: sorted(mods) for name, mods in owners.items()
-                  if len(mods) > 1}
-    assert not collisions, (
+    bad = [f.render() for f in _vocab_findings()
+           if f.rule == "metric-collision"]
+    assert not bad, (
         "metric families registered from multiple modules (move the "
-        f"registration to one owner and import the family): "
-        f"{collisions}")
+        "registration to one owner and import the family):\n"
+        + "\n".join(bad))
 
 
 # ------------------------------------------------------------------ #
 # event-type vocabulary (ISSUE-3)                                     #
 # ------------------------------------------------------------------ #
-def _is_emit_call(node: ast.Call) -> bool:
-    """Any ``emit("...")`` / ``emit_event("...")`` / ``<obj>.emit("...")``
-    with a literal type string counts as an event emission."""
-    func = node.func
-    if isinstance(func, ast.Name):
-        return func.id in ("emit", "emit_event")
-    if isinstance(func, ast.Attribute):
-        return func.attr == "emit"
-    return False
-
-
-def _emissions() -> List[Tuple[str, str]]:
-    """(module, event_type) for every literal-type emit call in the
-    package source."""
-    found = []
-    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            module = os.path.relpath(path, os.path.dirname(PACKAGE))
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=path)
-            for node in ast.walk(tree):
-                if (isinstance(node, ast.Call) and _is_emit_call(node)
-                        and node.args
-                        and isinstance(node.args[0], ast.Constant)
-                        and isinstance(node.args[0].value, str)):
-                    found.append((module, node.args[0].value))
-    return found
-
-
 def test_package_emits_events():
     """The emit walker works (an empty scan would vacuously pass):
     the known lifecycle/compile emissions are all found."""
-    types = {t for _, t in _emissions()}
+    types = {t for _, t, _ in collect_emissions(_project())}
     for expected in ("compile", "recompile_storm", "worker_start",
                      "worker_crash", "serving_error",
                      "postmortem_written"):
@@ -159,12 +83,8 @@ def test_package_emits_events():
 def test_event_types_follow_convention():
     """Every emitted literal type is lower_snake_case AND registered
     in obs.events.EVENT_TYPES -- the one vocabulary module."""
-    bad = []
-    for module, etype in _emissions():
-        try:
-            check_event_type(etype)
-        except ValueError as e:
-            bad.append(f"{module}: {e}")
+    bad = [f.render() for f in _vocab_findings()
+           if f.rule == "event-type"]
     assert not bad, "event type violations:\n" + "\n".join(bad)
 
 
@@ -180,24 +100,7 @@ def test_event_vocabulary_single_module():
     """EVENT_TYPES is assigned in obs/events.py and nowhere else --
     a second vocabulary module would fragment the namespace exactly
     the way cross-module metric registration would."""
-    owners = []
-    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=path)
-            for node in ast.walk(tree):
-                targets = []
-                if isinstance(node, ast.Assign):
-                    targets = node.targets
-                elif isinstance(node, ast.AnnAssign) and node.target:
-                    targets = [node.target]
-                for t in targets:
-                    if isinstance(t, ast.Name) and \
-                            t.id == "EVENT_TYPES":
-                        owners.append(os.path.relpath(
-                            path, os.path.dirname(PACKAGE)))
-    assert owners == [os.path.join("analytics_zoo_tpu", "obs",
-                                   "events.py")], owners
+    owners = sorted(rel for rel, _ in collect_vocab_owners(_project()))
+    assert owners == ["analytics_zoo_tpu/obs/events.py"], owners
+    assert not [f.render() for f in _vocab_findings()
+                if f.rule == "event-vocab-module"]
