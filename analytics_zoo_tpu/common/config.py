@@ -79,6 +79,37 @@ _DEFAULTS: Dict[str, Any] = {
     # port (the reference FrontEndApp pinned 10020 -- set that here to
     # reproduce its behavior)
     "zoo.serving.http_port": 0,
+    # resilience (serving/resilience.py): the launcher wraps the
+    # worker in a Supervisor that restarts it on death (thread crash)
+    # or wedge (stale heartbeat), with capped exponential backoff +
+    # jitter, re-queuing that run's in-flight requests exactly once
+    "zoo.serving.supervisor.enabled": True,
+    "zoo.serving.supervisor.poll_interval_s": 0.5,
+    "zoo.serving.supervisor.heartbeat_timeout_s": 30.0,
+    "zoo.serving.supervisor.backoff_base_s": 0.1,
+    "zoo.serving.supervisor.backoff_max_s": 30.0,
+    "zoo.serving.supervisor.max_restarts": 0,    # 0 = unlimited
+    # circuit breaker around backend dispatch: open after `threshold`
+    # consecutive predict failures, half-open probe after cooldown_s
+    "zoo.serving.breaker.enabled": False,
+    "zoo.serving.breaker.threshold": 5,
+    "zoo.serving.breaker.cooldown_s": 5.0,
+    # per-request deadline budget stamped at enqueue (0 = off): the
+    # worker rejects expired requests with a structured
+    # deadline_exceeded error at decode/dispatch/finalize instead of
+    # burning a device slot on an answer nobody is waiting for
+    "zoo.serving.deadline_ms": 0.0,
+    # load shedding (0 = off): InputQueue.enqueue refuses new work
+    # once queue depth reaches this, and the HTTP frontend turns the
+    # refusal into 503 + Retry-After instead of letting p99 explode
+    "zoo.serving.shed.queue_depth": 0,
+    "zoo.serving.shed.retry_after_s": 1.0,
+    # chaos harness (serving/chaos.py): seeded, deterministic fault
+    # injection behind the same seams the Supervisor watches; spec
+    # grammar "kind:seam[:k=v]*;..." (see docs/serving.md)
+    "zoo.serving.chaos.enabled": False,
+    "zoo.serving.chaos.seed": 0,
+    "zoo.serving.chaos.spec": "",
     # observability (analytics_zoo_tpu.obs): per-request tracing gate
     # (spans ride queue blobs as __trace__ and export as Chrome trace
     # JSON; off by default -- the disabled path must cost nothing),

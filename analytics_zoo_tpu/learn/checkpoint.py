@@ -108,7 +108,24 @@ def _atomic_write(path: str, data: bytes) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
+        f.flush()
+        # fsync BEFORE the rename: without it a SIGKILL/power-cut can
+        # leave the rename durable but the data not, i.e. `latest`
+        # pointing at a truncated checkpoint -- the one artifact a
+        # crash must never corrupt
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    try:
+        # and the directory entry itself, so the rename survives too
+        dir_fd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    except OSError as e:
+        # some filesystems refuse directory fsync; the data fsync
+        # above already bounds the damage to "old checkpoint visible"
+        logger.debug("directory fsync after %s skipped: %s", path, e)
 
 
 def _barrier() -> None:

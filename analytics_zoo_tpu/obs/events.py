@@ -75,6 +75,28 @@ EVENT_TYPES: Dict[str, str] = {
                         "(fields: cap, prev, depth)",
     "serving_error": "a per-request error reply was pushed "
                      "(fields: uri, error)",
+    # serving resilience (ISSUE-5)
+    "worker_restart": "supervisor restarting a dead or wedged serving "
+                      "worker (fields: reason, restarts, backoff_s, "
+                      "requeued)",
+    "supervisor_giveup": "supervisor hit its restart cap and stopped "
+                         "supervising (fields: restarts)",
+    "circuit_open": "circuit breaker opened after consecutive backend "
+                    "failures (fields: failures)",
+    "circuit_half_open": "circuit breaker allowing one half-open "
+                         "probe dispatch",
+    "circuit_closed": "circuit breaker closed again after a "
+                      "successful probe",
+    "request_shed": "input queue started shedding load at the "
+                    "configured depth (fields: depth, shed_depth)",
+    "deadline_exceeded": "a request missed its deadline and was "
+                         "rejected with a structured error "
+                         "(fields: uri, error)",
+    "redis_reconnect": "redis adapter result drain lost its queue "
+                       "backend and is retrying with backoff "
+                       "(fields: error, backoff_s)",
+    "chaos_injected": "a configured fault injector fired "
+                      "(fields: seam, kind)",
     "frontend_start": "HTTP frontend listening (fields: address)",
     "frontend_stop": "HTTP frontend stopped",
     "serving_launch": "launcher assembled a deployment "

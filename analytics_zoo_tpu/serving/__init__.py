@@ -7,6 +7,9 @@ Flink TM + Redis + akka with: a dependency-free durable queue (directory
 backend, atomic claim via rename; or in-memory for single-process),
 a micro-batcher with bounded backpressure, a serving worker around
 ``InferenceModel``, and a stdlib HTTP frontend with /predict + /metrics.
+Resilience (supervised restarts, circuit breaker, deadlines, load
+shedding) lives in ``resilience``; the deterministic fault-injection
+harness that proves it lives in ``chaos``.
 """
 
 from analytics_zoo_tpu.serving.queues import (  # noqa: F401
@@ -31,4 +34,13 @@ from analytics_zoo_tpu.serving.http_frontend import (  # noqa: F401
 )
 from analytics_zoo_tpu.serving.redis_adapter import (  # noqa: F401
     RedisFrontend,
+)
+from analytics_zoo_tpu.serving.resilience import (  # noqa: F401
+    CircuitBreaker,
+    RequestLedger,
+    Supervisor,
+)
+from analytics_zoo_tpu.serving.chaos import (  # noqa: F401
+    ChaosInjector,
+    parse_spec,
 )

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional
 
 from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.obs.metrics import get_registry
+from analytics_zoo_tpu.serving.chaos import chaos_point
 
 # why a batch closed, process-wide (obs registry): "size" = cap
 # reached, "deadline" = linger expired -- the ratio is the first thing
@@ -62,6 +63,7 @@ class MicroBatcher:
 
     def next_batch(self, wait_timeout: Optional[float] = 1.0
                    ) -> List[Any]:
+        chaos_point("pull")  # queue-stall / crash injection seam
         first = self.queue.get(timeout=wait_timeout)
         if first is None:
             return []
@@ -158,6 +160,7 @@ class AdaptiveBatcher(MicroBatcher):
     # ------------------------------------------------------------ pull --
     def next_batch(self, wait_timeout: Optional[float] = 1.0
                    ) -> List[Any]:
+        chaos_point("pull")  # queue-stall / crash injection seam
         first = self.queue.get(timeout=wait_timeout)
         if first is None:
             return []

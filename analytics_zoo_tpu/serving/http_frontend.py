@@ -45,7 +45,7 @@ from analytics_zoo_tpu.obs.events import get_event_log, to_jsonable
 from analytics_zoo_tpu.obs.flight import get_inflight
 from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.serving.timer import Timer
-from analytics_zoo_tpu.serving.worker import ERROR_KEY
+from analytics_zoo_tpu.serving.worker import DEADLINE_PREFIX, ERROR_KEY
 
 logger = get_logger(__name__)
 
@@ -167,6 +167,8 @@ class HttpFrontend:
         self.router = _ResultRouter(output_queue)
         self.worker = worker
         self.request_timeout = request_timeout
+        self.retry_after_s = float(get_config().get(
+            "zoo.serving.shed.retry_after_s", 1.0))
         self.timer = timer or Timer(mirror=_M_HTTP_STAGE)
         self._tls = certfile is not None
         self._started_at = time.time()
@@ -177,7 +179,8 @@ class HttpFrontend:
                 logger.debug("http: " + fmt, *args)
 
             def _reply(self, code: int, payload: Any,
-                       content_type: str = "application/json"):
+                       content_type: str = "application/json",
+                       headers: Optional[Dict[str, str]] = None):
                 # count BEFORE writing: the increment must be visible
                 # by the time the client has read the response, and a
                 # mid-write disconnect must still count the request
@@ -189,6 +192,8 @@ class HttpFrontend:
                         else json.dumps(payload).encode())
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -237,7 +242,14 @@ class HttpFrontend:
                     return
                 with frontend.timer.timing("predict_request"):
                     code, payload = frontend.handle_predict(req)
-                self._reply(code, payload)
+                headers = None
+                if code == 503:
+                    # load-shed / backpressure contract: every refused
+                    # /predict carries Retry-After so well-behaved
+                    # clients back off instead of hammering the queue
+                    headers = {"Retry-After": str(max(1, int(
+                        frontend.retry_after_s)))}
+                self._reply(code, payload, headers=headers)
 
         if self._tls:
             # HTTPS (ref: FrontEndApp.scala:40-130 supports --https-*
@@ -341,9 +353,14 @@ class HttpFrontend:
             self.router.register(uri)
             uris.append(uri)
             if not self._in.enqueue(uri, **tensors):
-                # bounded-queue backpressure -> 503 (the reference
-                # surfaces Redis OOM as an error, FrontEndApp/client.py)
-                return 503, {"error": "input queue full"}
+                # bounded-queue backpressure or admission-control
+                # shedding -> 503 (+ Retry-After header added by the
+                # handler); the reference surfaces Redis OOM as an
+                # error (FrontEndApp/client.py), we tell the client
+                # when to come back instead
+                return 503, {"error": "overloaded: input queue "
+                                      "refused the request",
+                             "retry_after_s": self.retry_after_s}
         return 200, None
 
     @staticmethod
@@ -366,7 +383,14 @@ class HttpFrontend:
         if result is None:
             return 504, {"error": "prediction timed out"}
         if ERROR_KEY in result:
-            return 500, {"error": str(result[ERROR_KEY])}
+            msg = str(result[ERROR_KEY])
+            if msg.startswith(DEADLINE_PREFIX):
+                # the worker's structured deadline rejection
+                # (zoo.serving.deadline_ms) is a timeout to the
+                # client, not a server fault
+                return 504, {"error": "deadline_exceeded",
+                             "detail": msg}
+            return 500, {"error": msg}
         return 200, _to_jsonable(result)
 
     # -------------------------------------------------------- lifecycle --

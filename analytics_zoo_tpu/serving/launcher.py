@@ -74,7 +74,7 @@ class ServingApp:
     def __init__(self, model: InferenceModel, worker: ServingWorker,
                  input_queue: InputQueue, output_queue: OutputQueue,
                  frontend: Optional[HttpFrontend],
-                 redis_frontend=None, reporter=None):
+                 redis_frontend=None, reporter=None, supervisor=None):
         self.model = model
         self.worker = worker
         self.input_queue = input_queue
@@ -82,12 +82,17 @@ class ServingApp:
         self.frontend = frontend
         self.redis_frontend = redis_frontend
         self.reporter = reporter
+        self.supervisor = supervisor
 
     @property
     def address(self) -> Optional[str]:
         return self.frontend.address if self.frontend else None
 
     def stop(self) -> None:
+        # supervisor FIRST: it exists to restart a stopping worker,
+        # which is exactly what an orderly shutdown must not fight
+        if self.supervisor is not None:
+            self.supervisor.stop()
         if self.frontend is not None:
             self.frontend.stop()
         if self.redis_frontend is not None:
@@ -125,6 +130,11 @@ def launch(config: Dict[str, Any]) -> ServingApp:
         from analytics_zoo_tpu.obs.flight import install_flight_recorder
 
         install_flight_recorder()
+    # chaos drills arm BEFORE the worker exists so launch-time seams
+    # are covered too (no-op unless zoo.serving.chaos.enabled)
+    from analytics_zoo_tpu.serving.chaos import maybe_install_from_config
+
+    maybe_install_from_config()
     model = _load_model(config)
     data = config.get("data") or {}
     params = config.get("params") or {}
@@ -188,6 +198,13 @@ def launch(config: Dict[str, Any]) -> ServingApp:
                 "warm_batch_sizes set but no example input is "
                 "available; skipping warm-up")
     worker.start()
+    supervisor = None
+    if bool(get_config().get("zoo.serving.supervisor.enabled", True)):
+        # the recovery story (ISSUE-5): restart a dead/wedged worker
+        # with backoff, re-queue its in-flight requests exactly once
+        from analytics_zoo_tpu.serving.resilience import Supervisor
+
+        supervisor = Supervisor(worker).start()
     frontend = None
     redis_fe = None
     reporter = None
@@ -236,6 +253,9 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     except Exception as e:
         emit_event("launch_failed", "serving", error=repr(e)[:500])
         # no ServingApp handle escapes; don't leak running pieces
+        # (supervisor first, or it would restart the worker we stop)
+        if supervisor is not None:
+            supervisor.stop()
         if frontend is not None:
             frontend.stop()
         if redis_fe is not None:
@@ -249,7 +269,8 @@ def launch(config: Dict[str, Any]) -> ServingApp:
         http=bool(http.get("enabled", True)),
         address=frontend.address if frontend is not None else None)
     return ServingApp(model, worker, in_q, out_q, frontend,
-                      redis_frontend=redis_fe, reporter=reporter)
+                      redis_frontend=redis_fe, reporter=reporter,
+                      supervisor=supervisor)
 
 
 def launch_from_yaml(path: str) -> ServingApp:

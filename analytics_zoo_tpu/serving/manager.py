@@ -9,9 +9,19 @@ launcher process; the manager tracks it with a state file
 
 CLI::
 
-    python -m analytics_zoo_tpu.serving.manager start  -c config.yaml
-    python -m analytics_zoo_tpu.serving.manager status [-n name]
-    python -m analytics_zoo_tpu.serving.manager stop   -n name
+    python -m analytics_zoo_tpu.serving.manager start   -c config.yaml
+    python -m analytics_zoo_tpu.serving.manager status  [-n name]
+    python -m analytics_zoo_tpu.serving.manager stop    -n name
+    python -m analytics_zoo_tpu.serving.manager restart -n name
+
+Liveness is identity-checked, not pid-checked: the state file records
+the launcher's /proc start time + cmdline at spawn, and ``status`` /
+``stop`` / duplicate-``start`` only treat a pid as "our deployment"
+when the identity still matches -- a recycled pid (days-old state file,
+busy host) no longer reads as a running deployment, and ``stop`` can
+no longer signal an innocent process. ``status`` garbage-collects the
+state files of dead deployments (reported once with
+``running: false``).
 """
 
 from __future__ import annotations
@@ -56,6 +66,44 @@ def _alive(pid: int) -> bool:
         return True
 
 
+def _proc_identity(pid: int):
+    """(starttime_ticks, cmdline) from /proc, or None where /proc (or
+    the process) is unavailable. The start time is the kernel's own
+    per-boot monotonic stamp -- two processes can share a recycled
+    pid, never a (pid, starttime) pair."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        # field 22 (starttime); split after the ")" because field 2
+        # (comm) may itself contain spaces/parens
+        starttime = int(stat.rsplit(b")", 1)[1].split()[19])
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = (f.read().replace(b"\0", b" ")
+                       .decode("utf-8", "replace").strip())
+        return starttime, cmdline
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _alive_state(state: Dict[str, Any]) -> bool:
+    """Is the deployment this STATE FILE describes still running --
+    i.e. the pid is alive AND still the process we spawned? Without
+    the identity check a recycled pid makes a stale state file read
+    as a running deployment (and makes ``stop`` SIGTERM a stranger).
+    Falls back to the bare pid probe when /proc identity is
+    unavailable (non-Linux) or the state file predates it."""
+    pid = state.get("pid", -1)
+    if not _alive(pid):
+        return False
+    recorded = state.get("starttime")
+    if recorded is None:
+        return True  # legacy state file: pid liveness is all we have
+    ident = _proc_identity(pid)
+    if ident is None:
+        return True  # no /proc: cannot disprove, keep legacy behavior
+    return ident[0] == recorded
+
+
 def start(config_path: str, name: Optional[str] = None,
           state_dir: Optional[str] = None,
           log_path: Optional[str] = None) -> Dict[str, Any]:
@@ -73,11 +121,10 @@ def start(config_path: str, name: Optional[str] = None,
     if os.path.isfile(state_file):
         with open(state_file) as f:
             old = json.load(f)
-        old_pid = old.get("pid", 0)
-        if _alive(old_pid):
+        if _alive_state(old):
             raise RuntimeError(
-                f"deployment {name!r} already running (pid {old_pid}); "
-                "stop it first")
+                f"deployment {name!r} already running "
+                f"(pid {old.get('pid', 0)}); stop it first")
     log_path = log_path or os.path.join(sdir, f"{name}.log")
     log_f = open(log_path, "ab")
     proc = subprocess.Popen(
@@ -89,8 +136,16 @@ def start(config_path: str, name: Optional[str] = None,
     state = {"name": name, "pid": proc.pid,
              "config": os.path.abspath(config_path),
              "log": log_path, "started_at": time.time()}
+    ident = _proc_identity(proc.pid)
+    if ident is not None:
+        # the anti-pid-reuse fingerprint _alive_state checks later
+        state["starttime"], state["cmdline"] = ident
     with open(state_file, "w") as f:
         json.dump(state, f)
+    try:
+        os.unlink(state_file + ".dead")  # superseded by the new run
+    except FileNotFoundError:
+        pass
     logger.info("started deployment %s (pid %d)", name, proc.pid)
     return state
 
@@ -98,7 +153,10 @@ def start(config_path: str, name: Optional[str] = None,
 def status(name: Optional[str] = None,
            state_dir: Optional[str] = None) -> List[Dict[str, Any]]:
     """State of one (or every) tracked deployment; each record gains
-    ``running: bool``."""
+    ``running: bool``. Dead deployments are reported ONCE and their
+    state files garbage-collected -- a crashed launcher (or a
+    recycled pid) stops haunting the listing, and a later ``start``
+    under the same name needs no manual cleanup."""
     sdir = state_dir or DEFAULT_STATE_DIR
     if not os.path.isdir(sdir):
         return []
@@ -112,7 +170,17 @@ def status(name: Optional[str] = None,
             continue
         with open(path) as f:
             state = json.load(f)
-        state["running"] = _alive(state.get("pid", -1))
+        state["running"] = _alive_state(state)
+        if not state["running"]:
+            logger.info("reaping stale state file for dead "
+                        "deployment %s (pid %s)", n, state.get("pid"))
+            try:
+                # parked as .dead, not unlinked: the obvious next move
+                # after seeing a dead deployment is `restart -n`,
+                # which needs the recorded config path
+                os.replace(path, path + ".dead")
+            except OSError:
+                pass  # another status call won the reap
         out.append(state)
     return out
 
@@ -129,11 +197,11 @@ def stop(name: str, state_dir: Optional[str] = None,
     pid = state.get("pid", 0)
     stopped = False
     try:
-        # the process can exit (or its pid be recycled to another
-        # user's process, where _alive's PermissionError reads as True)
-        # between the liveness check and the kill -- either way the
-        # deployment is gone; always fall through to state-file removal
-        if _alive(pid):
+        # identity-checked: a recycled pid must NOT receive our
+        # SIGTERM. The process can still exit between the check and
+        # the kill -- either way the deployment is gone; always fall
+        # through to state-file removal
+        if _alive_state(state):
             os.kill(pid, signal.SIGTERM)
             stopped = True  # the TERM landed: this call stopped it even
             deadline = time.time() + grace_s  # if a later check races
@@ -153,6 +221,34 @@ def stop(name: str, state_dir: Optional[str] = None,
     return stopped
 
 
+def restart(name: str, state_dir: Optional[str] = None,
+            grace_s: float = 10.0) -> Dict[str, Any]:
+    """Stop the deployment (if running) and start it again from the
+    config path its state file records. Works on dead deployments too
+    -- the common recovery move after a crash the in-process
+    Supervisor could not absorb (OOM kill, segfault)."""
+    path = _state_path(name, state_dir)
+    if not os.path.isfile(path):
+        # status() parks dead deployments' state as .dead -- restart
+        # is exactly the caller that still needs it
+        if os.path.isfile(path + ".dead"):
+            path = path + ".dead"
+        else:
+            raise FileNotFoundError(
+                f"no tracked deployment {name!r} (state file {path} "
+                "missing); use start -c <config>")
+    with open(path) as f:
+        state = json.load(f)
+    config_path = state.get("config")
+    if not config_path or not os.path.isfile(config_path):
+        raise FileNotFoundError(
+            f"deployment {name!r} records config {config_path!r}, "
+            "which no longer exists")
+    stop(name, state_dir=state_dir, grace_s=grace_s)
+    return start(config_path, name=name, state_dir=state_dir,
+                 log_path=state.get("log"))
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(
         description="analytics_zoo_tpu serving manager")
@@ -167,6 +263,9 @@ def main(argv=None) -> None:
     p_stop = sub.add_parser("stop")
     p_stop.add_argument("-n", "--name", required=True)
     p_stop.add_argument("--state-dir")
+    p_restart = sub.add_parser("restart")
+    p_restart.add_argument("-n", "--name", required=True)
+    p_restart.add_argument("--state-dir")
     args = ap.parse_args(argv)
     if args.cmd == "start":
         state = start(args.config, name=args.name,
@@ -177,6 +276,9 @@ def main(argv=None) -> None:
     elif args.cmd == "stop":
         ok = stop(args.name, state_dir=args.state_dir)
         print(json.dumps({"stopped": ok}))
+    elif args.cmd == "restart":
+        print(json.dumps(restart(args.name,
+                                 state_dir=args.state_dir)))
 
 
 if __name__ == "__main__":

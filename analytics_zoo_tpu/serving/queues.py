@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.obs import tracing as _tracing
+from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.obs.metrics import get_registry as _get_registry
 
 # client-side data-plane counters (the queues' entry in the unified
@@ -39,6 +40,10 @@ _M_ENQ_REJECTED = _REG.counter(
 _M_DEQ = _REG.counter(
     "zoo_serving_dequeue_total",
     "Results drained from the serving output queue")
+_M_SHED = _REG.counter(
+    "zoo_serving_shed_total",
+    "Requests refused by admission-control load shedding "
+    "(zoo.serving.shed.queue_depth)")
 
 # Wire format. v1 was np.savez (one zip archive per request): simple,
 # but the zip machinery costs ~260 us per request round-trip -- it was
@@ -53,7 +58,8 @@ _ZIP_MAGIC = b"PK"  # np.savez container (legacy v1 blobs)
 
 def _encode(uri: str, payload: Dict[str, np.ndarray],
             reply_to: Optional[str] = None,
-            trace_id: Optional[str] = None) -> bytes:
+            trace_id: Optional[str] = None,
+            deadline: Optional[float] = None) -> bytes:
     items = [("__uri__", np.asarray(uri))]
     if reply_to:
         # reply-to stream for brokered deployments: the worker that
@@ -64,6 +70,14 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
         # end-to-end tracing (obs.tracing): the id rides the blob so
         # worker stages can span against it; absent when tracing is off
         items.append(("__trace__", np.asarray(trace_id)))
+    if deadline is not None:
+        # absolute epoch-seconds deadline (zoo.serving.deadline_ms,
+        # stamped at enqueue): the worker rejects expired requests at
+        # decode/dispatch/finalize with a structured deadline_exceeded
+        # error. Wall-clock, not monotonic -- the blob may cross
+        # processes/hosts, and skew only shifts the budget by clock
+        # error, which deadline granularity (>= tens of ms) tolerates
+        items.append(("__deadline__", np.asarray(float(deadline))))
     for k, v in payload.items():
         a = np.asarray(v)
         if not a.flags["C_CONTIGUOUS"]:
@@ -90,7 +104,7 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
     return b"".join(parts)
 
 
-_META_KEYS = ("__uri__", "__reply__", "__trace__")
+_META_KEYS = ("__uri__", "__reply__", "__trace__", "__deadline__")
 
 
 def _decode(blob: bytes) -> Tuple[str, Dict[str, np.ndarray]]:
@@ -133,8 +147,18 @@ def _decode_full(blob: bytes
 
 def _decode_traced(blob: bytes) -> Tuple[str, Dict[str, np.ndarray],
                                          Optional[str], Optional[str]]:
-    """Full decode incl. the trace id meta key (what the worker's
-    decode stage uses; ``_decode_full`` keeps the historical 3-tuple)."""
+    """Full decode incl. the trace id meta key (``_decode_full`` keeps
+    the historical 3-tuple; the worker uses ``_decode_request``)."""
+    uri, tensors, reply, trace, _ = _decode_request(blob)
+    return uri, tensors, reply, trace
+
+
+def _decode_request(blob: bytes
+                    ) -> Tuple[str, Dict[str, np.ndarray],
+                               Optional[str], Optional[str],
+                               Optional[float]]:
+    """The worker's decode: (uri, tensors, reply_to, trace_id,
+    deadline) with every meta key stripped from the tensor dict."""
     if blob[:4] == _MAGIC:
         z = _decode_raw(blob)
         uri = str(z["__uri__"].reshape(())) if "__uri__" in z else ""
@@ -142,8 +166,10 @@ def _decode_traced(blob: bytes) -> Tuple[str, Dict[str, np.ndarray],
                  if "__reply__" in z else None)
         trace = (str(z["__trace__"].reshape(()))
                  if "__trace__" in z else None)
+        deadline = (float(z["__deadline__"].reshape(()))
+                    if "__deadline__" in z else None)
         return uri, {k: v for k, v in z.items()
-                     if k not in _META_KEYS}, reply, trace
+                     if k not in _META_KEYS}, reply, trace, deadline
     if not blob.startswith(_ZIP_MAGIC):
         raise ValueError("not a serving wire blob (neither AZT1 nor "
                          "legacy npz framing)")
@@ -151,8 +177,10 @@ def _decode_traced(blob: bytes) -> Tuple[str, Dict[str, np.ndarray],
         uri = str(z["__uri__"])
         reply = str(z["__reply__"]) if "__reply__" in z.files else None
         trace = str(z["__trace__"]) if "__trace__" in z.files else None
+        deadline = (float(z["__deadline__"])
+                    if "__deadline__" in z.files else None)
         return uri, {k: z[k] for k in z.files
-                     if k not in _META_KEYS}, reply, trace
+                     if k not in _META_KEYS}, reply, trace, deadline
 
 
 class MemQueue:
@@ -503,30 +531,77 @@ class InputQueue:
     def __init__(self, backend=None, path: Optional[str] = None,
                  maxlen: Optional[int] = 10000, queue=None,
                  name: str = "serving_stream",
-                 reply_stream: Optional[str] = None):
+                 reply_stream: Optional[str] = None,
+                 shed_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None):
         self._q = queue if queue is not None else _make_backend(
             backend, path, maxlen, name=name)
         # when set, every request carries this reply-to stream so the
         # serving worker routes its result back to THIS producer's
         # result stream (brokered multi-frontend deployments)
         self.reply_stream = reply_stream
+        # admission control (ISSUE-5), resolved ONCE at construction
+        # so the disabled path stays one int/float compare per
+        # enqueue: shed_depth refuses new work above a backlog depth
+        # (softer than maxlen -- the queue still absorbs in-flight
+        # producers, the frontend turns the refusal into 503 +
+        # Retry-After); deadline_ms stamps each blob with an absolute
+        # deadline the worker enforces at every stage
+        from analytics_zoo_tpu.common.config import get_config
+
+        cfg = get_config()
+        self.shed_depth = int(
+            cfg.get("zoo.serving.shed.queue_depth", 0)
+            if shed_depth is None else shed_depth)
+        self.deadline_ms = float(
+            cfg.get("zoo.serving.deadline_ms", 0.0)
+            if deadline_ms is None else deadline_ms)
+        self._shedding = False
 
     @property
     def queue(self):
         return self._q
 
     def enqueue(self, uri: str, **tensors) -> bool:
-        """False means the queue is full (backpressure; the reference
-        surfaces Redis OOM errors here, client.py:176-192). A trace
-        context open on this thread (obs.tracing) rides the blob as
-        ``__trace__`` -- one thread-local read when tracing is off."""
+        """False means the queue refused the request -- full (hard
+        backpressure; the reference surfaces Redis OOM errors here,
+        client.py:176-192) or shedding (depth >= ``shed_depth``). A
+        trace context open on this thread (obs.tracing) rides the blob
+        as ``__trace__`` -- one thread-local read when tracing is off."""
+        if self.shed_depth and self._shed():
+            return False
+        deadline = (time.time() + self.deadline_ms / 1000.0
+                    if self.deadline_ms else None)
         ok = self._q.put(_encode(uri, tensors,
                                  reply_to=self.reply_stream,
-                                 trace_id=_tracing.current_trace_id()))
+                                 trace_id=_tracing.current_trace_id(),
+                                 deadline=deadline))
         _M_ENQ.inc()
         if not ok:
             _M_ENQ_REJECTED.inc()
         return ok
+
+    def _shed(self) -> bool:
+        """Shed-or-admit; the depth probe costs one len() per enqueue
+        (a broker RPC on TcpQueue backends), which is why shedding is
+        opt-in via ``zoo.serving.shed.queue_depth``."""
+        try:
+            depth = len(self._q)
+        except (TypeError, OSError):
+            return False  # depth-less backend: cannot shed on depth
+        if depth < self.shed_depth:
+            self._shedding = False
+            return False
+        _M_ENQ.inc()
+        _M_SHED.inc()
+        if not self._shedding:
+            # one event per shed EPISODE, not per refused request --
+            # under a real overload the per-request rate would churn
+            # the whole event ring with copies of the same fact
+            self._shedding = True
+            emit_event("request_shed", "serving", depth=depth,
+                       shed_depth=self.shed_depth)
+        return True
 
     def enqueue_image(self, uri: str, data, key: str = "image") -> bool:
         """Enqueue a COMPRESSED image (JPEG/PNG file path or bytes);

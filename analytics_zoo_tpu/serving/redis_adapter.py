@@ -40,10 +40,17 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.obs.events import emit as emit_event
 
 logger = get_logger(__name__)
 
 RESULT_PREFIX = "cluster-serving_"
+
+# result-drain reconnect backoff (capped exponential): the drain loop
+# must survive a broker/queue-backend outage, not die on the first
+# ConnectionError and silently strand every future client poll
+_RECONNECT_BASE_S = 0.05
+_RECONNECT_MAX_S = 5.0
 
 
 # ------------------------------------------------------------- arrow --
@@ -267,16 +274,36 @@ class RedisFrontend:
             t.join(timeout=2.0)
 
     def _drain_loop(self) -> None:
+        backoff = _RECONNECT_BASE_S
         while not self._stop.is_set():
-            moved = 0
-            for uri, tensors in self._out.dequeue_all():
-                key = f"{RESULT_PREFIX}{self.name}:{uri}"
-                with self._lock:
-                    self._results[key] = {
-                        "value": encode_result_value(tensors)}
-                moved += 1
-            if not moved:
-                time.sleep(0.005)
+            try:
+                moved = 0
+                for uri, tensors in self._out.dequeue_all():
+                    key = f"{RESULT_PREFIX}{self.name}:{uri}"
+                    with self._lock:
+                        self._results[key] = {
+                            "value": encode_result_value(tensors)}
+                    moved += 1
+                backoff = _RECONNECT_BASE_S  # healthy pass: reset
+                if not moved:
+                    time.sleep(0.005)
+            except (ConnectionError, OSError) as e:
+                # the output queue's backend dropped (broker restart,
+                # network blip): this thread IS the result path --
+                # dying here permanently strands every client poll, so
+                # retry forever with capped exponential backoff. The
+                # TcpQueue client reconnects per request; we just keep
+                # asking.
+                if self._stop.is_set():
+                    return
+                emit_event("redis_reconnect", "serving",
+                           error=str(e)[:200],
+                           backoff_s=round(backoff, 3))
+                logger.warning(
+                    "redis adapter result drain lost its queue "
+                    "backend (%s); retrying in %.2fs", e, backoff)
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2.0, _RECONNECT_MAX_S)
 
     # ------------------------------------------------------ commands --
     def _dispatch(self, conn: _RespConnection,

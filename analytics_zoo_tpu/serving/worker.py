@@ -46,8 +46,9 @@ from analytics_zoo_tpu.obs.flight import get_inflight
 from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.obs.tracing import get_tracer
 from analytics_zoo_tpu.serving.batcher import AdaptiveBatcher, MicroBatcher
+from analytics_zoo_tpu.serving.chaos import chaos_point
 from analytics_zoo_tpu.serving.queues import (
-    TcpQueue, _decode_traced, _encode)
+    TcpQueue, _decode_request, _encode)
 from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
@@ -77,8 +78,20 @@ _M_OCCUPANCY = _REG.histogram(
 _M_INFLIGHT = _REG.gauge(
     "zoo_serving_inflight_batches_items",
     "Dispatched batches awaiting finalize (pipeline window fill)")
+_M_DEADLINE = _REG.counter(
+    "zoo_serving_deadline_exceeded_total",
+    "Requests rejected for missing their zoo.serving.deadline_ms "
+    "budget (the catching stage rides the error message/event)")
 
 ERROR_KEY = "__error__"
+
+# structured-error message prefixes: the error REPLY is a plain string
+# on the wire, so the class of failure rides as a greppable prefix --
+# the frontend maps deadline errors to 504, and _push_error picks the
+# right event/counter without a second argument threading through the
+# in-flight record tuples
+DEADLINE_PREFIX = "deadline_exceeded"
+CIRCUIT_PREFIX = "circuit_open"
 
 # compressed-image magic numbers: requests may ship JPEG/PNG bytes
 # instead of raw pixel tensors (the reference decodes base64 images
@@ -244,7 +257,8 @@ class ServingWorker:
                  pipeline_depth: Optional[int] = None,
                  pipelined: Optional[bool] = None,
                  min_timeout_ms: Optional[float] = None,
-                 max_batch_size: Optional[int] = None):
+                 max_batch_size: Optional[int] = None,
+                 breaker=None):
         cfg = get_config()
         if batch_size is None:
             batch_size = int(cfg.get("zoo.serving.batch_size", 8))
@@ -301,6 +315,28 @@ class ServingWorker:
         # live handle on the pipelined engine's in-flight window (for
         # metrics); set for the duration of a pipelined run
         self._inflight_q: Optional[_pyqueue.Queue] = None
+        # resilience hooks (ISSUE-5) -- all None/absent-cheap when off:
+        # * ledger: a Supervisor attaches a RequestLedger so the
+        #   requests a dead run had pulled can be re-queued exactly
+        #   once (recorded at decode, settled on reply);
+        # * breaker: CircuitBreaker consulted before dispatch, fed by
+        #   predict failures/successes (config-gated default);
+        # * heartbeat: stamped by every stage loop iteration, read by
+        #   the Supervisor's wedge detector.
+        self.ledger = None
+        if breaker is None and bool(
+                cfg.get("zoo.serving.breaker.enabled", False)):
+            from analytics_zoo_tpu.serving.resilience import (
+                CircuitBreaker)
+
+            breaker = CircuitBreaker()
+        self.breaker = breaker
+        self.heartbeat = time.monotonic()
+        # decode stage's own heartbeat: None while no decode thread is
+        # running (sync engine, bounded runs after their decode loop
+        # finished) -- the supervisor only reads it when set, so a
+        # finished decode loop cannot read as a wedge
+        self.heartbeat_decode: Optional[float] = None
 
     def _count_served(self, n: int) -> None:
         """Single owner of the served counters (instance total + the
@@ -313,6 +349,7 @@ class ServingWorker:
     def process_one_batch(self, wait_timeout: float = 1.0) -> int:
         """One pull->predict->push cycle (the synchronous engine);
         returns requests served."""
+        self.heartbeat = time.monotonic()
         with self.timer.timing("batch_wait"):
             blobs = self.batcher.next_batch(wait_timeout=wait_timeout)
         if not blobs:
@@ -335,6 +372,12 @@ class ServingWorker:
         self._decode_per_item = decode_s / max(1, len(items))
         n = n_failed
         for group in groups:
+            group, expired = self._split_expired(group, "dispatch")
+            for uri, reply, msg in expired:
+                self._push_error(uri, reply, msg)
+            n += len(expired)
+            if not group:
+                continue
             try:
                 n += self._predict_group(group)
             except Exception as e:  # input_fn/output_fn bugs must not
@@ -353,28 +396,69 @@ class ServingWorker:
     # ------------------------------------------------------- stages -----
     def _decode_stage(self, blobs) -> Tuple[List, List, float]:
         """Wire-decode a pulled micro-batch, then image-decode through
-        the shared thread pool. Returns (items, image_failures,
-        decode_seconds); items are (uri, tensors, reply, trace)."""
+        the shared thread pool. Returns (items, failures,
+        decode_seconds); items are (uri, tensors, reply, trace,
+        deadline), failures are (uri, reply, message) -- undecodable
+        images plus requests already past their deadline."""
         t0 = time.perf_counter()
         with self.timer.timing("decode", batch=len(blobs)):
             items: List[Tuple[str, Dict[str, np.ndarray],
-                              Optional[str], Optional[str]]]
+                              Optional[str], Optional[str],
+                              Optional[float]]]
             try:  # fast path: no per-item try frames on clean batches
-                items = [_decode_traced(b) for b in blobs]
+                items = [_decode_request(b) for b in blobs]
+                if self.ledger is not None:
+                    for b, it in zip(blobs, items):
+                        self.ledger.record(it[0], b)
             except Exception:
                 items = []
                 for b in blobs:
                     try:
-                        items.append(_decode_traced(b))
+                        items.append(_decode_request(b))
                     except Exception as e:  # malformed blob: drop,
                         logger.exception(   # keep serving
                             "serving: undecodable request dropped: %s",
                             e)
+                        continue
+                    if self.ledger is not None:
+                        self.ledger.record(items[-1][0], b)
+            # chaos seam AFTER the ledger record: blobs are already
+            # off the input queue, so a stage death here must be
+            # requeue-covered or the requests would vanish replyless
+            # (the only residual uncovered window is the wire-decode
+            # loop itself)
+            chaos_point("decode")
             items, bad_images = decode_image_batch(items)
+            items, expired = self._split_expired(items, "decode")
         t1 = time.perf_counter()
         self._emit_spans("decode", (it[3] for it in items), t0, t1,
                          batch=len(items))
-        return items, bad_images, t1 - t0
+        return items, bad_images + expired, t1 - t0
+
+    def _split_expired(self, items, stage: str):
+        """Partition a batch on its per-request deadlines: (live,
+        expired-error-tuples). Requests without a deadline (the
+        default wire format) always pass -- the common case is one
+        ``is None`` check per request."""
+        expired = []
+        live = None  # copy-on-write: stays None on the no-expiry path
+        now = None
+        for i, it in enumerate(items):
+            deadline = it[4]
+            if deadline is not None:
+                if now is None:
+                    now = time.time()
+                if now > deadline:
+                    if live is None:
+                        live = list(items[:i])
+                    expired.append(
+                        (it[0], it[2],
+                         f"{DEADLINE_PREFIX}: request missed its "
+                         f"deadline before {stage}"))
+                    continue
+            if live is not None:
+                live.append(it)
+        return (items if live is None else live), expired
 
     @staticmethod
     def _emit_spans(name, traces, t0: float, t1: float, **args) -> None:
@@ -407,9 +491,19 @@ class ServingWorker:
         -- (``_BATCH``, ...) awaiting finalize, or (``_ERRORS``, ...)
         when dispatch failed. Stack/input_fn exceptions propagate (the
         caller owns the per-request error mapping for those)."""
+        chaos_point("dispatch")
         uris = [it[0] for it in group]
         replies = [it[2] for it in group]
         traces = [it[3] if len(it) > 3 else None for it in group]
+        deadlines = [it[4] if len(it) > 4 else None for it in group]
+        if self.breaker is not None and not self.breaker.allow():
+            # open circuit: fast-fail the whole group instead of
+            # burning a device slot on a backend that keeps dying
+            self.breaker.rejected(len(group))
+            return (_ERRORS,
+                    [(u, r, f"{CIRCUIT_PREFIX}: backend dispatch "
+                            "suspended after repeated failures")
+                     for u, r in zip(uris, replies)])
         t0 = time.perf_counter()  # this group's own prep starts here
         with self.timer.timing("stack", batch=len(group)):
             stacked = {
@@ -425,6 +519,8 @@ class ServingWorker:
                     preds, n = self.model.predict(x), len(group)
         except Exception as e:  # push per-request errors, keep serving
             logger.exception("serving predict failed: %s", e)
+            if self.breaker is not None:
+                self.breaker.record_failure()
             return (_ERRORS, [(u, r, str(e))
                               for u, r in zip(uris, replies)])
         # start the device->host result copy NOW: by finalize time
@@ -452,7 +548,8 @@ class ServingWorker:
         # in-flight registry: a crash postmortem names exactly which
         # requests were lost (one set update per BATCH, not per request)
         get_inflight().add(uris)
-        return (_BATCH, uris, replies, preds, n, prep_s, traces)
+        return (_BATCH, uris, replies, preds, n, prep_s, traces,
+                deadlines)
 
     def _predict_group(self, group) -> int:
         rec = self._dispatch_group(group)
@@ -465,13 +562,22 @@ class ServingWorker:
 
     def _finalize_one(self) -> int:
         """Materialize the oldest in-flight batch and push its results
-        (async dispatch errors surface here)."""
-        return self._finalize_record(self._inflight.popleft())
+        (async dispatch errors surface here). The pop is race-guarded:
+        after a wedge restart an abandoned run's drain can briefly
+        overlap the new run on this deque (deque ops are atomic, the
+        check-then-pop is not) -- losing the race must cost nothing,
+        not an IndexError that kills a serving thread."""
+        try:
+            rec = self._inflight.popleft()
+        except IndexError:
+            return 0
+        return self._finalize_record(rec)
 
     def _finalize_record(self, rec) -> int:
         """Finalize stage for one in-flight record. Never raises:
         push-path failures (broker down, spool disk full) must not kill
         the serving loop -- callers sit outside the batch guard."""
+        chaos_point("finalize")
         if rec[0] == _ERRORS:
             try:
                 for uri, reply, msg in rec[1]:
@@ -481,13 +587,20 @@ class ServingWorker:
                     "serving error-push failed (%d error replies "
                     "lost): %s", len(rec[1]), e)
             return len(rec[1])
-        _, uris, replies, preds, n, prep_s, traces = rec
+        _, uris, replies, preds, n, prep_s, traces, deadlines = rec
         t0 = time.perf_counter()
         try:
             try:
-                served = self._finalize_inner(uris, replies, preds, n)
+                served = self._finalize_inner(uris, replies, preds, n,
+                                              deadlines)
             finally:  # answered (or accounted): off the crash manifest
                 get_inflight().discard(uris)
+                if self.ledger is not None:
+                    # settled = this engine accounted for the request
+                    # (reply pushed, or its loss logged); the
+                    # supervisor must not re-queue it after a later
+                    # crash -- that would duplicate the reply
+                    self.ledger.settle(uris)
             t1 = time.perf_counter()
             self._emit_spans("finalize", traces, t0, t1,
                              batch=len(uris))
@@ -506,7 +619,8 @@ class ServingWorker:
                              "requests lost): %s", len(uris), e)
             return len(uris)
 
-    def _finalize_inner(self, uris, replies, preds, n) -> int:
+    def _finalize_inner(self, uris, replies, preds, n,
+                        deadlines=None) -> int:
         import jax
 
         try:
@@ -515,9 +629,25 @@ class ServingWorker:
                     lambda a: np.asarray(a)[:n], preds)
         except Exception as e:
             logger.exception("serving predict failed: %s", e)
+            if self.breaker is not None:
+                self.breaker.record_failure()
             for uri, reply in zip(uris, replies):
                 self._push_error(uri, reply, str(e))
             return len(uris)
+        if self.breaker is not None:
+            # fetch materialized: the backend really answered -- this
+            # is the success signal that closes a half-open breaker
+            self.breaker.record_success()
+        # finalize-time deadline check: the device slot is spent, but
+        # a reply nobody is waiting for must still be the STRUCTURED
+        # error the contract promises, not a late result
+        late = None
+        if deadlines is not None and any(
+                d is not None for d in deadlines):
+            now = time.time()
+            late = [d is not None and now > d for d in deadlines]
+            if not any(late):
+                late = None
         with self.timer.timing("postprocess", batch=len(uris)):
             # hot path: the common single-ndarray output with default
             # hooks slices rows directly -- per-request jax tree_map
@@ -527,10 +657,12 @@ class ServingWorker:
                     and self.output_fn is _default_output_fn
                     and isinstance(preds, np.ndarray))
             backend = getattr(self._out_q, "queue", self._out_q)
-            if (fast and not any(replies)
+            if (fast and late is None and not any(replies)
                     and hasattr(backend, "put_many")):
                 # one batched push: per-item lock/notify trips cost
                 # more than the encode itself at adaptive batch sizes
+                if chaos_point("push"):
+                    return len(uris)  # injected drop-reply
                 blobs = [_encode(uri, {"output": preds[i]})
                          for i, uri in enumerate(uris)]
                 accepted = backend.put_many(blobs)
@@ -541,6 +673,12 @@ class ServingWorker:
                 return len(uris)
             for i, (uri, reply) in enumerate(zip(uris, replies)):
                 try:
+                    if late is not None and late[i]:
+                        self._push_error(
+                            uri, reply,
+                            f"{DEADLINE_PREFIX}: request missed its "
+                            "deadline before finalize")
+                        continue
                     if fast:
                         self._push(uri, reply, {"output": preds[i]})
                         continue
@@ -558,11 +696,16 @@ class ServingWorker:
 
     # ---------------------------------------------- pipelined engine ----
     def _run_pipelined(self, max_batches: Optional[int],
-                       wait_timeout: float) -> int:
+                       wait_timeout: float,
+                       stop_ev: threading.Event) -> int:
         """The staged engine: decode thread -> assembly/dispatch (this
         thread) -> finalize thread, bounded by ``pipeline_depth``
         dispatched batches in flight. A bounded run returns only after
-        every request it pulled is answered."""
+        every request it pulled is answered. ``stop_ev`` is THIS run's
+        stop event (captured, not ``self._stop``): a supervisor
+        restart hands the next run a fresh event, so an abandoned
+        wedged thread that wakes later sees its own set event and
+        exits instead of double-serving."""
         decoded_q: _pyqueue.Queue = _pyqueue.Queue(
             maxsize=max(2, self.pipeline_depth))
         inflight_q: _pyqueue.Queue = _pyqueue.Queue(
@@ -582,7 +725,11 @@ class ServingWorker:
         def decode_loop():
             pulled = 0
             try:
-                while not self._stop.is_set() and not abort.is_set():
+                while not stop_ev.is_set() and not abort.is_set():
+                    # iterates at least every wait_timeout when idle
+                    # (next_batch returns empty), so staleness means
+                    # STUCK (hung broker recv, chaos stall), not idle
+                    self.heartbeat_decode = time.monotonic()
                     if max_batches is not None and pulled >= max_batches:
                         break
                     pulled += 1
@@ -610,6 +757,7 @@ class ServingWorker:
                 logger.exception(   # still close the pipeline cleanly
                     "serving decode stage failed: %s", e)
             finally:
+                self.heartbeat_decode = None  # not running != wedged
                 put_stage(decoded_q, _SENTINEL)
 
         def finalize_loop():
@@ -617,6 +765,7 @@ class ServingWorker:
                 rec = inflight_q.get()
                 if rec is _SENTINEL:
                     return
+                self.heartbeat = time.monotonic()
                 try:
                     n = self._finalize_record(rec)
                 except Exception as e:  # belt-and-braces: this thread
@@ -639,7 +788,19 @@ class ServingWorker:
         try:
             while True:
                 with self.timer.timing("assembly_wait"):
-                    item = decoded_q.get()
+                    # the DRIVER owns the supervision heartbeat: it is
+                    # the thread that holds device work, so "driver
+                    # stuck in dispatch/finalize backpressure" is
+                    # exactly the wedge the Supervisor must catch --
+                    # a sliced wait keeps the heartbeat fresh while
+                    # verifiably idle, stale only when truly stuck
+                    while True:
+                        self.heartbeat = time.monotonic()
+                        try:
+                            item = decoded_q.get(timeout=0.5)
+                            break
+                        except _pyqueue.Empty:
+                            continue
                 if item is _SENTINEL:
                     break
                 items, bad_images, decode_s = item
@@ -651,8 +812,15 @@ class ServingWorker:
                     inflight_q.put((_ERRORS, list(bad_images)))
                 if not items:
                     continue
+                self.heartbeat = time.monotonic()
                 self._decode_per_item = decode_s / max(1, len(items))
                 for group in self._group_compatible(items):
+                    group, expired = self._split_expired(group,
+                                                         "dispatch")
+                    if expired:  # deadline hit while queued in-engine
+                        inflight_q.put((_ERRORS, expired))
+                    if not group:
+                        continue
                     try:
                         rec = self._dispatch_group(group)
                     except Exception as e:  # input_fn bugs etc.
@@ -693,18 +861,24 @@ class ServingWorker:
             wait_timeout: float = 0.05) -> int:
         """Serve until stopped (or ``max_batches`` pull cycles); returns
         total requests served in this call."""
+        stop_ev = self._stop  # capture: this RUN's stop event -- see
+        # _run_pipelined's docstring for the restart semantics
         if self.pipelined:
-            return self._run_pipelined(max_batches, wait_timeout)
+            return self._run_pipelined(max_batches, wait_timeout,
+                                       stop_ev)
         total = 0
         batches = 0
-        while not self._stop.is_set():
+        while not stop_ev.is_set():
             total += self.process_one_batch(wait_timeout=wait_timeout)
             batches += 1
             if max_batches is not None and batches >= max_batches:
                 break
         # a bounded run returns only after everything it pulled is
-        # answered (pipelined batches must not linger past the call)
-        while self._inflight:
+        # answered (pipelined batches must not linger past the call).
+        # Identity-gated: after a wedge restart this may be an
+        # ABANDONED run waking up -- the deque now belongs to the new
+        # run, whose own drain answers these records
+        while self._inflight and self._stop is stop_ev:
             n = self._finalize_one()
             self._count_served(n)
             total += n
@@ -722,7 +896,13 @@ class ServingWorker:
             raise
 
     def start(self) -> "ServingWorker":
-        self._stop.clear()
+        # a FRESH stop event per run (not .clear()): a previous run's
+        # thread that is still draining -- or was abandoned by a
+        # supervisor wedge restart -- holds the old event and must
+        # keep seeing it set, or it would resume serving next to the
+        # new thread
+        self._stop = threading.Event()
+        self.heartbeat = time.monotonic()
         self._thread = threading.Thread(target=self.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -754,6 +934,8 @@ class ServingWorker:
     # --------------------------------------------------------- outputs --
     def _push(self, uri: str, reply: Optional[str],
               tensors: Dict[str, np.ndarray]) -> None:
+        if chaos_point("push"):
+            return  # injected drop-reply
         backend = self._reply_backend(reply)
         if not backend.put(_encode(uri, tensors)):
             logger.warning("output queue full: dropping result for %s",
@@ -776,12 +958,21 @@ class ServingWorker:
         # reserved out-of-band key (the "__uri__" convention of
         # queues._encode) so model outputs named "error" stay usable
         _M_ERRORS.inc()
-        # error replies are rare by construction (the hot path never
-        # reaches here), so a structured event per error is cheap and
-        # makes /debug/events the first stop for "why did request X
-        # fail" instead of log spelunking
-        emit_event("serving_error", "serving", uri=uri,
-                   error=message[:500])
+        if message.startswith(DEADLINE_PREFIX):
+            _M_DEADLINE.inc()
+            emit_event("deadline_exceeded", "serving", uri=uri,
+                       error=message[:500])
+        elif not message.startswith(CIRCUIT_PREFIX):
+            # breaker rejections happen at batch scale while open; the
+            # circuit_open/closed transition events carry that story,
+            # a per-request event would flood the ring. Everything
+            # else is rare by construction, so a structured event per
+            # error is cheap and makes /debug/events the first stop
+            # for "why did request X fail" instead of log spelunking
+            emit_event("serving_error", "serving", uri=uri,
+                       error=message[:500])
+        if self.ledger is not None:
+            self.ledger.settle((uri,))
         self._push(uri, reply, {ERROR_KEY: np.asarray(message)})
 
     # --------------------------------------------------------- metrics --
@@ -802,8 +993,13 @@ class ServingWorker:
             # cannot answer right now): depth is best-effort metadata,
             # omit the field rather than fail the metrics call
             pass
-        return {"served": self.served, "stages": self.timer.summary(),
-                "pipeline": pipe}
+        out = {"served": self.served, "stages": self.timer.summary(),
+               "pipeline": pipe}
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.stats()
+        if self.ledger is not None:
+            out["ledger_outstanding"] = len(self.ledger)
+        return out
 
 
 def _tree_index(preds, i: int):
