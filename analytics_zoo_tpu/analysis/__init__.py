@@ -15,10 +15,25 @@ review time:
 - ``config_keys``    ``zoo.*`` config-key drift between use sites,
                      ``common.config._DEFAULTS``, and the docs
                      glossary (resolves helper-wrapper/prefix access
-                     that naive grep misses)
+                     that naive grep misses), plus ``config-type``
+                     cast/default checks against the ``_SPECS``
+                     type/range metadata
 - ``vocabulary``     metric-name and event-type conventions (one
                      registry with obs.metrics / obs.events)
 - ``hygiene``        silent ``except Exception: pass`` blocks
+- ``mesh_rules``     mesh/collective correctness: axis-name
+                     resolution against the ``zoo.mesh.axis.*``
+                     vocabulary, shard_map in_specs arity,
+                     unsharded-axis reductions, nested collectives
+                     (dataflow-powered: one level of variable
+                     indirection resolves)
+- ``protocol``       serving wire-protocol contracts: reserved wire
+                     keys and structured error prefixes have ONE
+                     declaring module (serving/protocol.py); inline
+                     copies, typos, and unmapped prefixes are
+                     findings
+- ``dataflow``       the shared reaching-definitions +
+                     constant-propagation layer the above build on
 
 Entry points: ``scripts/zoolint.py`` (CLI, baseline-aware, ``--json``)
 and ``tests/test_zoolint.py`` (tier-1 gate). Findings suppress inline

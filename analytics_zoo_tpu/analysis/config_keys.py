@@ -23,6 +23,17 @@ and docs:
     the glossary or it does not exist. Skipped when the project has
     no docs tree (fixtures).
 
+``config-type`` (error)
+    Cross-boundary type/range drift against the ``_SPECS`` metadata
+    dict next to ``_DEFAULTS`` (per-key ``("int", lo, hi)`` /
+    ``("float", lo, hi)`` / ``("bool",)`` / ``("str",)`` /
+    ``("enum", ...)`` shapes): a ``get``/``set`` call site whose
+    literal default/value contradicts the declared type, falls
+    outside the declared range, or whose wrapping ``int()``/
+    ``float()``/``str()`` cast contradicts the declared type; plus
+    self-checks -- a spec for an undeclared key, or a ``_DEFAULTS``
+    value violating its own spec.
+
 Docstring string constants are excluded from use-site detection: a
 key *described* in prose is not a key *read*.
 """
@@ -40,29 +51,91 @@ _KEY_RE = re.compile(r"^zoo(\.[a-z0-9_]+)+$")
 _CONFIG_METHODS = {"get", "set", "unset"}
 
 
-def _defaults_decl(src: SourceFile
-                   ) -> Optional[Dict[str, int]]:
-    """{key: lineno} when this module assigns a dict of zoo.* string
-    keys to ``_DEFAULTS`` at top level."""
+def _dict_decl(src: SourceFile, name: str) -> Optional[ast.Dict]:
+    """The top-level ``<name> = {...}`` dict node of this module."""
     for node in src.tree.body:
         targets = []
         if isinstance(node, ast.Assign):
             targets = node.targets
-        elif isinstance(node, ast.AnnAssign):  # _DEFAULTS: Dict[...] = {}
+        elif isinstance(node, ast.AnnAssign):  # name: Dict[...] = {}
             targets = [node.target]
-        if not (any(isinstance(t, ast.Name) and t.id == "_DEFAULTS"
-                    for t in targets)
+        if (any(isinstance(t, ast.Name) and t.id == name
+                for t in targets)
                 and isinstance(getattr(node, "value", None), ast.Dict)):
-            continue
-        out: Dict[str, int] = {}
-        for k in node.value.keys:
-            if (isinstance(k, ast.Constant)
-                    and isinstance(k.value, str)
-                    and _KEY_RE.match(k.value)):
-                out[k.value] = k.lineno
-        if out:
-            return out
+            return node.value
     return None
+
+
+def _defaults_decl(src: SourceFile
+                   ) -> Optional[Dict[str, int]]:
+    """{key: lineno} when this module assigns a dict of zoo.* string
+    keys to ``_DEFAULTS`` at top level."""
+    value = _dict_decl(src, "_DEFAULTS")
+    if value is None:
+        return None
+    out: Dict[str, int] = {}
+    for k in value.keys:
+        if (isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and _KEY_RE.match(k.value)):
+            out[k.value] = k.lineno
+    return out or None
+
+
+def _literal(node: ast.AST):
+    """Python constant of a literal expression (incl. -5), else a
+    _NO_LITERAL sentinel."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and isinstance(node.operand.value, (int, float))):
+        return -node.operand.value
+    return _NO_LITERAL
+
+
+_NO_LITERAL = object()
+
+
+def _specs_decl(src: SourceFile) -> Optional[Dict[str, tuple]]:
+    """{key: (lineno, spec tuple)} from a top-level ``_SPECS`` dict of
+    ``key: ("type", ...)`` literal entries; malformed entries are
+    skipped (conservative)."""
+    value = _dict_decl(src, "_SPECS")
+    if value is None:
+        return None
+    out: Dict[str, tuple] = {}
+    for k, v in zip(value.keys, value.values):
+        if not (isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and isinstance(v, (ast.Tuple, ast.List)) and v.elts):
+            continue
+        elems = [_literal(e) for e in v.elts]
+        if any(e is _NO_LITERAL for e in elems) or not isinstance(
+                elems[0], str):
+            continue
+        out[k.value] = (k.lineno, tuple(elems))
+    return out or None
+
+
+def _spec_violation(spec: tuple, value) -> Optional[str]:
+    """Why ``value`` (a python literal) violates ``spec``, or None --
+    delegates to the ONE shared implementation in common.config so
+    the lint rule and launch-time validation cannot drift apart."""
+    from analytics_zoo_tpu.common.config import spec_violation
+
+    return spec_violation(spec, value)
+
+
+# cast name -> spec kinds it contradicts (a float() around an int key
+# is widening and fine; an int() around a float key truncates; any
+# numeric cast around a str/enum key means the type metadata is wrong
+# on one side of the boundary)
+_CAST_CONFLICTS = {
+    "int": ("str", "enum", "float"),
+    "float": ("str", "enum"),
+    "str": ("int", "float", "bool"),
+}
 
 
 def _literal_prefix(node: ast.AST) -> Optional[str]:
@@ -138,6 +211,10 @@ class ConfigKeyChecker(Checker):
                          "tree",
         "config-undocumented": "declared _DEFAULTS key never "
                                "mentioned in docs/*.md",
+        "config-type": "get/set call site whose cast or literal "
+                       "default contradicts the key's _SPECS "
+                       "type/range metadata (or a spec/_DEFAULTS "
+                       "self-inconsistency)",
     }
 
     def check_project(self, project: Project) -> Iterable[Finding]:
@@ -151,6 +228,7 @@ class ConfigKeyChecker(Checker):
         if decl_src is None:
             return  # nothing to reconcile against
         uses = collect_uses(project, skip=decl_src)
+        yield from self._check_types(project, decl_src, declared)
 
         for key, sites in sorted(uses.config_calls.items()):
             if key in declared:
@@ -181,3 +259,94 @@ class ConfigKeyChecker(Checker):
                     line,
                     f"config key '{key}' is not mentioned in any "
                     "docs/*.md; add it to the config glossary")
+
+    # ------------------------------------------------- config-type ----
+    def _check_types(self, project: Project, decl_src: SourceFile,
+                     declared: Dict[str, int]) -> Iterable[Finding]:
+        specs = _specs_decl(decl_src)
+        if specs is None:
+            # metadata may live next to a separate _DEFAULTS fixture
+            for src in project.files:
+                specs = _specs_decl(src)
+                if specs is not None:
+                    break
+        if specs is None:
+            return
+
+        # self-checks: spec'd key must be declared; the _DEFAULTS
+        # literal must satisfy its own spec
+        defaults_dict = _dict_decl(decl_src, "_DEFAULTS")
+        default_values: Dict[str, object] = {}
+        if defaults_dict is not None:
+            for k, v in zip(defaults_dict.keys, defaults_dict.values):
+                if isinstance(k, ast.Constant) and isinstance(
+                        k.value, str):
+                    default_values[k.value] = _literal(v)
+        for key, (line, spec) in sorted(specs.items()):
+            if key not in declared:
+                yield Finding(
+                    "config-type", "error", decl_src.rel, line,
+                    f"_SPECS declares metadata for '{key}' but "
+                    "_DEFAULTS does not declare the key")
+                continue
+            default = default_values.get(key, _NO_LITERAL)
+            if default is not _NO_LITERAL:
+                why = _spec_violation(spec, default)
+                if why:
+                    yield Finding(
+                        "config-type", "error", decl_src.rel, line,
+                        f"_DEFAULTS value for '{key}' violates its "
+                        f"own _SPECS entry: {why}")
+
+        # use sites: literal-key get/set defaults + wrapping casts
+        for src in project.files:
+            if src is decl_src:
+                continue
+            parents: Dict[int, ast.AST] = {}
+            for node in ast.walk(src.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[id(child)] = node
+            for node in ast.walk(src.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("get", "set")
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                key = node.args[0].value
+                if key not in specs:
+                    continue
+                _line, spec = specs[key]
+                if len(node.args) > 1:
+                    value = _literal(node.args[1])
+                    if value is not _NO_LITERAL:
+                        # get(key, None) = "absent is fine" sentinel,
+                        # not a typed default -- never a finding
+                        if not (node.func.attr == "get"
+                                and value is None):
+                            why = _spec_violation(spec, value)
+                            if why:
+                                word = ("default"
+                                        if node.func.attr == "get"
+                                        else "value")
+                                yield Finding(
+                                    "config-type", "error", src.rel,
+                                    node.lineno,
+                                    f"config {node.func.attr}() "
+                                    f"{word} for '{key}' contradicts "
+                                    f"its _SPECS entry: {why}")
+                parent = parents.get(id(node))
+                if (isinstance(parent, ast.Call)
+                        and isinstance(parent.func, ast.Name)
+                        and len(parent.args) == 1
+                        and parent.args[0] is node):
+                    conflicts = _CAST_CONFLICTS.get(parent.func.id)
+                    if conflicts and spec[0] in conflicts:
+                        yield Finding(
+                            "config-type", "error", src.rel,
+                            parent.lineno,
+                            f"{parent.func.id}() cast around config "
+                            f"key '{key}' contradicts its declared "
+                            f"'{spec[0]}' type (fix the cast or the "
+                            "_SPECS entry)")

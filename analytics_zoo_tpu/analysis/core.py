@@ -15,9 +15,11 @@ Design rules (shared by every checker family):
   ground truth spans modules).
 - **Suppression is local and named.** ``# zoolint: disable=<rule>``
   (comma-separated, or ``all``) on the flagged line or the line above
-  silences exactly that rule there; unexplained global ignores don't
-  exist. Grandfathered findings go in the baseline file with a
-  rationale instead (analysis.baseline).
+  silences exactly that rule there; a comment anywhere inside a
+  multi-line *simple* statement (a ``shard_map(...)`` call spanning
+  six lines) covers the whole statement span. Unexplained global
+  ignores don't exist. Grandfathered findings go in the baseline file
+  with a rationale instead (analysis.baseline).
 """
 
 from __future__ import annotations
@@ -74,7 +76,42 @@ class SourceFile:
                 rules = {r.strip() for r in m.group(1).split(",")
                          if r.strip()}
                 self._suppress[i] = rules
+        self._span_suppress = self._collect_span_suppressions(self.tree)
         self._docstrings = self._collect_docstrings(self.tree)
+
+    # compound statements own sub-statements with their own spans; only
+    # SIMPLE statements (an Assign/Expr holding a multi-line call) get
+    # whole-span suppression, so a disable comment inside a 50-line
+    # ``if`` body never silences sibling lines. Match/TryStar exist
+    # only on newer pythons, hence the getattr defaults.
+    _COMPOUND_STMTS = (ast.If, ast.For, ast.AsyncFor, ast.While,
+                       ast.With, ast.AsyncWith, ast.Try,
+                       ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef,
+                       getattr(ast, "Match", ast.Try),
+                       getattr(ast, "TryStar", ast.Try))
+
+    def _collect_span_suppressions(self, tree: ast.AST
+                                   ) -> Dict[int, Set[str]]:
+        """{line: rules} spreading each simple statement's suppression
+        comments (plus the line above the statement) over its full
+        [lineno, end_lineno] span -- a multi-line ``shard_map(...)``
+        call is suppressible no matter which line the finding names."""
+        out: Dict[int, Set[str]] = {}
+        for node in ast.walk(tree):
+            if (not isinstance(node, ast.stmt)
+                    or isinstance(node, self._COMPOUND_STMTS)):
+                continue
+            end = getattr(node, "end_lineno", None) or node.lineno
+            if end <= node.lineno:
+                continue  # single-line: the plain lookup covers it
+            rules: Set[str] = set()
+            for ln in range(node.lineno - 1, end + 1):
+                rules |= self._suppress.get(ln, set())
+            if rules:
+                for ln in range(node.lineno, end + 1):
+                    out.setdefault(ln, set()).update(rules)
+        return out
 
     @staticmethod
     def _collect_docstrings(tree: ast.AST) -> Set[int]:
@@ -95,12 +132,15 @@ class SourceFile:
 
     def suppressed(self, rule: str, line: int) -> bool:
         """True when the line (or the line directly above it) carries
-        ``# zoolint: disable=`` naming this rule or ``all``."""
+        ``# zoolint: disable=`` naming this rule or ``all`` -- or when
+        the line sits inside a multi-line simple statement any of whose
+        lines (or the line above it) does."""
         for ln in (line, line - 1):
             rules = self._suppress.get(ln)
             if rules and (rule in rules or "all" in rules):
                 return True
-        return False
+        rules = self._span_suppress.get(line)
+        return bool(rules and (rule in rules or "all" in rules))
 
 
 class Project:
@@ -164,7 +204,8 @@ def register(cls: type) -> type:
 def _load_builtin_checkers() -> None:
     # import for side effect: each module @register-s its checkers
     from analytics_zoo_tpu.analysis import (  # noqa: F401
-        concurrency, config_keys, hygiene, trace_hazards, vocabulary)
+        concurrency, config_keys, hygiene, mesh_rules, protocol,
+        trace_hazards, vocabulary)
 
 
 def all_checkers() -> List[Checker]:
@@ -239,12 +280,26 @@ def collect_files(paths: Sequence[str],
 def run_zoolint(paths: Sequence[str],
                 rules: Optional[Sequence[str]] = None,
                 checkers: Optional[Sequence[Checker]] = None,
-                repo_root: Optional[str] = None) -> List[Finding]:
+                repo_root: Optional[str] = None,
+                report_only: Optional[Sequence[str]] = None
+                ) -> List[Finding]:
     """Run checkers over ``paths``; returns suppression-filtered
     findings sorted by (path, line, rule). ``rules`` restricts to a
-    subset; ``checkers`` overrides the registry (unit tests)."""
+    subset; ``checkers`` overrides the registry (unit tests).
+
+    ``report_only`` (absolute file paths) is the ``--changed`` fast
+    path: the whole tree is still parsed -- project checkers need the
+    cross-module ground truth (``_DEFAULTS``, vocabulary owners) to
+    stay sound -- but per-file checkers run only on the listed files
+    and every finding outside them is dropped."""
     files, repo_root = collect_files(paths, repo_root=repo_root)
     project = Project(files, repo_root=repo_root)
+    only_rel: Optional[Set[str]] = None
+    if report_only is not None:
+        only_rel = {
+            os.path.relpath(os.path.abspath(p),
+                            repo_root).replace(os.sep, "/")
+            for p in report_only}
     if checkers is None:
         checkers = all_checkers()
     wanted = set(rules) if rules else None
@@ -254,11 +309,15 @@ def run_zoolint(paths: Sequence[str],
     findings: List[Finding] = []
     for checker in checkers:
         for src in files:
+            if only_rel is not None and src.rel not in only_rel:
+                continue
             findings.extend(checker.check_file(src))
         findings.extend(checker.check_project(project))
     kept = []
     for f in findings:
         if wanted is not None and f.rule not in wanted:
+            continue
+        if only_rel is not None and f.path not in only_rel:
             continue
         src = project.file(f.path)
         if src is not None and f.line and src.suppressed(f.rule, f.line):

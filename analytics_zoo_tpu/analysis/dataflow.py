@@ -1,0 +1,337 @@
+"""Lightweight intraprocedural dataflow for zoolint checkers.
+
+Checkers built on the one-parse :class:`~analytics_zoo_tpu.analysis.
+core.SourceFile` often need to answer "what *string* does this
+expression hold at the use site?" -- an ``axis_name`` handed to
+``lax.psum``, a wire key indexed out of a decoded blob, a prefix
+passed to ``startswith``. A pure literal scan misses the repo's
+dominant indirection idioms::
+
+    axis = config_axis("model")          # helper-wrapper call
+    SPEC_AXIS = "seq"                    # module-level constant
+    lax.psum(x, axis)                    # <- resolve to the value
+
+This module implements the minimal machinery those checkers need:
+**reaching definitions** (which assignments can bind a name at a use
+site, walking lexical scopes inward-out) plus **literal/constant
+propagation** (folding constants, ``+``-concatenation, constant
+f-strings, and ternaries into a *set of possible values*).
+
+Design rules:
+
+- **Conservative by construction.** Anything the walk cannot prove
+  returns ``None`` ("unknown") and the caller must not report a
+  finding. A name bound by a loop target, ``with ... as``, unpacking,
+  augmented assignment, a ``match`` capture, or a function parameter
+  is unknown. A name assigned several times resolves only when every
+  assignment resolves to the SAME value set -- the walk has no
+  statement ordering, so differing reassignments (``axis = "model"``
+  ... ``axis = status_msg``) are unknown rather than a union that
+  would let a later unrelated value indict an earlier correct use.
+- **Intraprocedural.** Resolution never crosses a call boundary; the
+  one sanctioned exception is :class:`ConfigAxis`, a symbolic marker
+  for the ``parallel.mesh.config_axis("<role>")`` helper so mesh
+  checkers can validate the *role* against declared
+  ``zoo.mesh.axis.*`` keys without knowing the deployment's axis
+  spelling.
+- **Scope chains are explicit.** Callers pass the lexical nesting
+  (module node outermost, then each enclosing function) so closures
+  resolve through enclosing-function and module constants exactly
+  like Python's own name lookup (minus ``global``/``nonlocal``
+  rebinding, which taints the name to unknown).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+# resolution result values are python constants (str/int/float/bool/
+# None) or ConfigAxis markers; a result SET is always hashable
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigAxis:
+    """Symbolic value of ``config_axis(role[, fallback])`` -- the
+    mesh-axis helper that reads ``zoo.mesh.axis.<role>``. ``fallback``
+    is the literal fallback when it was resolvable, else None."""
+
+    role: str
+    fallback: Optional[str] = None
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_MAX_DEPTH = 20  # cycle/depth guard for a = b; b = a chains
+
+
+def _param_names(node: ast.AST) -> Set[str]:
+    args = getattr(node, "args", None)
+    if args is None:
+        return set()
+    names = {a.arg for a in (list(args.posonlyargs) + list(args.args)
+                             + list(args.kwonlyargs))}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+class Scope:
+    """Name bindings of one lexical scope (module or function body).
+
+    ``assigns`` holds the value expressions of *simple* assignments
+    (``name = expr`` / annotated form); ``tainted`` holds names bound
+    any other way (params, loop targets, ``with as``, unpacking,
+    imports, ``+=``, walrus, ``global``/``nonlocal``) -- those resolve
+    to unknown.
+    """
+
+    def __init__(self, node: ast.AST):
+        self.node = node
+        self.assigns: Dict[str, List[ast.expr]] = {}
+        self.tainted: Set[str] = set(_param_names(node))
+        body = getattr(node, "body", [])
+        if isinstance(body, ast.expr):  # Lambda: expression body
+            body = []
+        for stmt in body:
+            self._visit_stmt(stmt)
+
+    # -- statement walk that stays inside this scope ------------------
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, _SCOPE_NODES + (ast.ClassDef,)):
+            return  # nested scope: its bindings are not ours
+        if isinstance(stmt, ast.Assign):
+            if (len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                self.assigns.setdefault(
+                    stmt.targets[0].id, []).append(stmt.value)
+            else:
+                for t in stmt.targets:
+                    self._taint_target(t)
+        elif isinstance(stmt, ast.AnnAssign):
+            if isinstance(stmt.target, ast.Name):
+                if stmt.value is not None:
+                    self.assigns.setdefault(
+                        stmt.target.id, []).append(stmt.value)
+            else:
+                self._taint_target(stmt.target)
+        elif isinstance(stmt, ast.AugAssign):
+            self._taint_target(stmt.target)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._taint_target(stmt.target)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self._taint_target(item.optional_vars)
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for alias in stmt.names:
+                self.tainted.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(stmt, (ast.Global, ast.Nonlocal)):
+            # a rebinding declaration makes local reasoning unsound
+            self.tainted.update(stmt.names)
+        # walrus assignments anywhere in expressions taint their name
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.NamedExpr) and isinstance(
+                    sub.target, ast.Name):
+                self.tainted.add(sub.target.id)
+        # recurse into compound-statement bodies (same scope)
+        for field in ("body", "orelse", "finalbody"):
+            for child in getattr(stmt, field, []) or []:
+                if isinstance(child, ast.stmt):
+                    self._visit_stmt(child)
+        for handler in getattr(stmt, "handlers", []) or []:
+            if handler.name:
+                self.tainted.add(handler.name)
+            for child in handler.body:
+                self._visit_stmt(child)
+        # match statements: capture patterns bind names (unknown), and
+        # case bodies are this scope too -- skipping them would leave
+        # their rebindings invisible and make resolution wrong rather
+        # than conservatively unknown
+        for case in getattr(stmt, "cases", []) or []:
+            for sub in ast.walk(case.pattern):
+                name = getattr(sub, "name", None)
+                if isinstance(name, str):
+                    self.tainted.add(name)
+                rest = getattr(sub, "rest", None)
+                if isinstance(rest, str):
+                    self.tainted.add(rest)
+            for child in case.body:
+                self._visit_stmt(child)
+
+    def _taint_target(self, target: ast.expr) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                self.tainted.add(node.id)
+
+    def binds(self, name: str) -> bool:
+        return name in self.assigns or name in self.tainted
+
+
+class ScopeChain:
+    """Lexical chain outermost-module -> ... -> innermost function.
+
+    Built lazily from raw AST nodes; :meth:`resolve` answers with a
+    frozenset of possible constant values or ``None`` for unknown.
+    """
+
+    def __init__(self, nodes: Sequence[ast.AST]):
+        self._scopes = [Scope(n) for n in nodes]
+
+    def push(self, node: ast.AST) -> "ScopeChain":
+        child = ScopeChain.__new__(ScopeChain)
+        child._scopes = self._scopes + [Scope(node)]
+        return child
+
+    # ---------------------------------------------------- resolution --
+    def resolve(self, node: ast.AST,
+                _depth: int = 0) -> Optional[FrozenSet]:
+        """Set of possible values of ``node``, or None when unknown."""
+        if _depth > _MAX_DEPTH:
+            return None
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, (str, int, float, bool,
+                                       type(None))):
+                return frozenset([node.value])
+            return None
+        if isinstance(node, ast.Name):
+            return self._resolve_name(node.id, _depth)
+        if isinstance(node, ast.IfExp):
+            a = self.resolve(node.body, _depth + 1)
+            b = self.resolve(node.orelse, _depth + 1)
+            if a is None or b is None:
+                return None
+            return a | b
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left = self.resolve(node.left, _depth + 1)
+            right = self.resolve(node.right, _depth + 1)
+            if left is None or right is None:
+                return None
+            out = set()
+            for l in left:
+                for r in right:
+                    if isinstance(l, str) and isinstance(r, str):
+                        out.add(l + r)
+                    else:
+                        return None
+            return frozenset(out)
+        if isinstance(node, ast.JoinedStr):
+            # constant f-string (every piece a literal) folds; any
+            # formatted hole makes it unknown
+            parts: List[FrozenSet] = []
+            for value in node.values:
+                if isinstance(value, ast.Constant) and isinstance(
+                        value.value, str):
+                    parts.append(frozenset([value.value]))
+                elif isinstance(value, ast.FormattedValue):
+                    inner = self.resolve(value.value, _depth + 1)
+                    if inner is None or not all(
+                            isinstance(v, str) for v in inner):
+                        return None
+                    parts.append(inner)
+                else:
+                    return None
+            outs = {""}
+            for part in parts:
+                outs = {a + b for a in outs for b in part}
+            return frozenset(outs)
+        if isinstance(node, ast.Call):
+            return self._resolve_call(node, _depth)
+        return None
+
+    def _resolve_name(self, name: str,
+                      _depth: int) -> Optional[FrozenSet]:
+        for scope in reversed(self._scopes):
+            if not scope.binds(name):
+                continue
+            if name in scope.tainted:
+                return None
+            sets: List[FrozenSet] = []
+            for expr in scope.assigns[name]:
+                resolved = self.resolve(expr, _depth + 1)
+                if resolved is None:
+                    return None
+                sets.append(resolved)
+            # no statement ordering here: several assignments resolve
+            # only when they agree, else the binding is unknown (a
+            # union would let an unrelated later value indict an
+            # earlier correct use)
+            if any(s != sets[0] for s in sets[1:]):
+                return None
+            return sets[0]
+        return None  # free name (import/builtin): unknown
+
+    def _resolve_call(self, node: ast.Call,
+                      _depth: int) -> Optional[FrozenSet]:
+        func = node.func
+        fname = None
+        if isinstance(func, ast.Name):
+            fname = func.id
+        elif isinstance(func, ast.Attribute):
+            fname = func.attr
+        if fname == "config_axis" and node.args:
+            role = self.resolve(node.args[0], _depth + 1)
+            if role is None or len(role) != 1:
+                return None
+            (role_v,) = role
+            if not isinstance(role_v, str):
+                return None
+            fallback: Optional[str] = None
+            for kw in node.keywords:
+                if kw.arg == "fallback":
+                    fb = self.resolve(kw.value, _depth + 1)
+                    if fb is not None and len(fb) == 1:
+                        (fb_v,) = fb
+                        if isinstance(fb_v, str):
+                            fallback = fb_v
+            if fallback is None and len(node.args) > 1:
+                fb = self.resolve(node.args[1], _depth + 1)
+                if fb is not None and len(fb) == 1:
+                    (fb_v,) = fb
+                    if isinstance(fb_v, str):
+                        fallback = fb_v
+            return frozenset([ConfigAxis(role_v, fallback)])
+        if fname == "str" and len(node.args) == 1:
+            inner = self.resolve(node.args[0], _depth + 1)
+            if inner is not None and all(isinstance(v, (str, ConfigAxis))
+                                         for v in inner):
+                return inner
+        return None
+
+    def resolve_strings(self, node: ast.AST
+                        ) -> Optional[FrozenSet]:
+        """Like :meth:`resolve`, but only accepts results made of
+        strings, ``None``, and :class:`ConfigAxis` markers (the shapes
+        axis/key checkers understand); anything else is unknown."""
+        values = self.resolve(node)
+        if values is None:
+            return None
+        if all(v is None or isinstance(v, (str, ConfigAxis))
+               for v in values):
+            return values
+        return None
+
+
+def module_chain(tree: ast.Module) -> ScopeChain:
+    return ScopeChain([tree])
+
+
+def walk_with_scopes(tree: ast.Module):
+    """Yield ``(node, chain)`` for every AST node, where ``chain`` is
+    the ScopeChain of lexical scopes *enclosing* the node (the node's
+    own scope included once inside its body). Scope objects are built
+    once per function, not per node."""
+    base = module_chain(tree)
+
+    def visit(node: ast.AST, chain: ScopeChain):
+        for child in ast.iter_child_nodes(node):
+            child_chain = chain
+            if isinstance(child, _SCOPE_NODES):
+                child_chain = chain.push(child)
+            yield child, child_chain
+            yield from visit(child, child_chain)
+
+    yield tree, base
+    yield from visit(tree, base)

@@ -1,0 +1,387 @@
+"""Checker family 6: mesh / collective correctness (shardcheck).
+
+Every remaining scaling direction (multi-chip serving, continuous
+batching, the fleet) routes work through ``parallel/`` -- ``shard_map``
+bodies calling ``psum``/``ppermute``/``all_gather`` over named mesh
+axes. An ``axis_name`` typo or an ``in_specs`` arity mismatch fails
+only at runtime on a real multi-device mesh, the most expensive place
+to find it. These rules validate the distributed plan statically, on
+top of the :mod:`analytics_zoo_tpu.analysis.dataflow` layer so one
+level of variable indirection (``axis = config_axis("model")``,
+``AXIS = "tp"``) resolves to the value at the use site.
+
+Ground truth (found structurally, so fixture projects work):
+
+- the ``zoo.mesh.axis.<role>`` entries of any scanned module's
+  ``_DEFAULTS`` dict -- both the *roles* and their default axis-name
+  values;
+- module-level ``*_AXIS = "<name>"`` constants (``DATA_AXIS`` etc. in
+  ``parallel/mesh.py``);
+- axis names literally present in the ``in_specs``/``out_specs`` of
+  the ``shard_map`` call wrapping the function under scrutiny.
+
+Rules:
+
+``mesh-axis-unbound`` (error)
+    A collective whose axis argument *resolves* to a string that no
+    vocabulary source declares and the enclosing specs never mention,
+    or to ``config_axis("<role>")`` with an undeclared role. An
+    unresolvable axis (function parameter, computed value) is never a
+    finding -- the walk is conservative.
+
+``mesh-spec-arity`` (error)
+    A ``shard_map`` call whose literal ``in_specs`` tuple length
+    cannot match the wrapped function's positional signature (specs
+    are the exact argument tuple the mapped call receives).
+
+``mesh-unsharded-axis`` (warning)
+    A collective inside a ``shard_map`` body over a *declared* axis
+    that the wrapping call's fully-literal specs never shard: the
+    operand is replicated over that axis, so e.g. ``psum`` silently
+    multiplies by the axis size. Skipped whenever the specs contain
+    anything non-literal (the set of sharded axes is then unknown).
+
+``mesh-nested-collective`` (warning)
+    A collective whose operand expression already contains a
+    collective over the same axis name (``psum(psum(x, "a"), "a")``):
+    almost always a double reduction from refactored helper layers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from analytics_zoo_tpu.analysis.core import (
+    Checker, Finding, Project, SourceFile, register)
+from analytics_zoo_tpu.analysis.dataflow import (
+    ConfigAxis, ScopeChain, walk_with_scopes)
+
+# collective name -> positional index of its axis-name argument
+_COLLECTIVES: Dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+    "all_gather": 1, "psum_scatter": 1, "ppermute": 1,
+    "all_to_all": 1, "axis_index": 0, "axis_size": 0,
+    # parallel.collectives wrappers (same contract, repo idiom)
+    "all_reduce_sum": 1, "all_reduce_mean": 1, "reduce_scatter": 1,
+    "ring_permute": 1, "global_norm": 1,
+}
+_AXIS_KWARG = "axis_name"
+# DATA_AXIS / FSDP_AXIS / ... declaration-constant naming (suffix
+# anchored so e.g. an _AXIS_KWARG helper string is not a declaration)
+_AXIS_CONST_RE = re.compile(r"(^|_)AXIS$")
+
+
+def _call_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _axis_arg(node: ast.Call) -> Optional[ast.expr]:
+    """The axis-name argument expression of a collective call."""
+    name = _call_name(node.func)
+    idx = _COLLECTIVES.get(name or "")
+    if idx is None:
+        return None
+    for kw in node.keywords:
+        if kw.arg == _AXIS_KWARG:
+            return kw.value
+    if len(node.args) > idx:
+        return node.args[idx]
+    return None
+
+
+def _spec_axes(node: ast.AST) -> Tuple[Set[str], bool]:
+    """(axis names, fully_literal) of a specs expression: every string
+    constant inside counts as an axis; any Name/Call other than
+    ``P``/``PartitionSpec`` construction makes the set incomplete."""
+    axes: Set[str] = set()
+    complete = True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant):
+            if isinstance(sub.value, str):
+                axes.add(sub.value)
+        elif isinstance(sub, ast.Call):
+            if _call_name(sub.func) not in ("P", "PartitionSpec"):
+                complete = False
+        elif isinstance(sub, ast.Name):
+            if sub.id not in ("P", "PartitionSpec", "None"):
+                complete = False
+        elif not isinstance(sub, (ast.Tuple, ast.List, ast.Load,
+                                  ast.Attribute, ast.keyword,
+                                  ast.Starred)):
+            if not isinstance(sub, ast.expr_context):
+                complete = False
+    return axes, complete
+
+
+def _positional_arity(fn: ast.AST) -> Optional[Tuple[int, int]]:
+    """(min, max) positional-argument count of a def/lambda, or None
+    when *args makes it unbounded."""
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    if args.vararg is not None:
+        return None
+    pos = list(args.posonlyargs) + list(args.args)
+    n = len(pos)
+    return n - len(args.defaults), n
+
+
+class _ShardMapInfo:
+    """One shard_map call: the wrapped fn (when statically known), the
+    axes its literal specs shard, and whether that set is complete."""
+
+    def __init__(self, call: ast.Call):
+        self.call = call
+        self.axes: Set[str] = set()
+        self.complete = True
+        self.in_specs: Optional[ast.expr] = None
+        for kw in call.keywords:
+            if kw.arg in ("in_specs", "out_specs"):
+                if kw.arg == "in_specs":
+                    self.in_specs = kw.value
+                axes, complete = _spec_axes(kw.value)
+                self.axes |= axes
+                self.complete = self.complete and complete
+            elif kw.arg == "axis_names":
+                axes, complete = _spec_axes(kw.value)
+                self.axes |= axes
+                self.complete = self.complete and complete
+
+
+@register
+class MeshCollectiveChecker(Checker):
+    name = "mesh"
+    rules = {
+        "mesh-axis-unbound": "collective axis name resolves to a "
+                             "string no zoo.mesh.axis.* key, *_AXIS "
+                             "constant, or enclosing shard_map spec "
+                             "declares (typo'd axis)",
+        "mesh-spec-arity": "shard_map in_specs tuple length cannot "
+                           "match the wrapped function's positional "
+                           "signature",
+        "mesh-unsharded-axis": "collective over a declared axis the "
+                               "enclosing shard_map's specs never "
+                               "shard (replicated operand: psum "
+                               "multiplies by axis size)",
+        "mesh-nested-collective": "collective nested inside another "
+                                  "collective over the same axis "
+                                  "(double reduction)",
+    }
+
+    # ---------------------------------------------------- vocabulary --
+    @staticmethod
+    def _axis_vocabulary(project: Project
+                         ) -> Tuple[Set[str], Set[str]]:
+        """(axis-name values, config roles) declared anywhere in the
+        scanned tree."""
+        values: Set[str] = set()
+        roles: Set[str] = set()
+        for src in project.files:
+            for node in src.tree.body:
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                for t in targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    value = getattr(node, "value", None)
+                    if (t.id == "_DEFAULTS"
+                            and isinstance(value, ast.Dict)):
+                        for k, v in zip(value.keys, value.values):
+                            if (isinstance(k, ast.Constant)
+                                    and isinstance(k.value, str)
+                                    and k.value.startswith(
+                                        "zoo.mesh.axis.")):
+                                roles.add(
+                                    k.value[len("zoo.mesh.axis."):])
+                                if (isinstance(v, ast.Constant)
+                                        and isinstance(v.value, str)):
+                                    values.add(v.value)
+                    elif (_AXIS_CONST_RE.search(t.id)
+                          and isinstance(value, ast.Constant)
+                          and isinstance(value.value, str)):
+                        values.add(value.value)
+        return values, roles
+
+    # -------------------------------------------------- per-file scan --
+    @staticmethod
+    def _shard_map_wrappings(src: SourceFile
+                             ) -> Tuple[Dict[str, List[_ShardMapInfo]],
+                                        List[Tuple[ast.Lambda,
+                                                   _ShardMapInfo]]]:
+        """{fn name: wrapping shard_map calls} + (lambda, wrapping)."""
+        by_name: Dict[str, List[_ShardMapInfo]] = {}
+        lambdas: List[Tuple[ast.Lambda, _ShardMapInfo]] = []
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and _call_name(node.func) == "shard_map"
+                    and node.args):
+                continue
+            info = _ShardMapInfo(node)
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                by_name.setdefault(target.id, []).append(info)
+            elif isinstance(target, ast.Lambda):
+                lambdas.append((target, info))
+        return by_name, lambdas
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        vocab_values, vocab_roles = self._axis_vocabulary(project)
+        for src in project.files:
+            yield from self._check_file(src, vocab_values, vocab_roles)
+
+    def _check_file(self, src: SourceFile, vocab_values: Set[str],
+                    vocab_roles: Set[str]) -> Iterable[Finding]:
+        by_name, wrapped_lambdas = self._shard_map_wrappings(src)
+
+        # defs by name (for arity + body context); ambiguous names
+        # (two defs sharing one name) are skipped everywhere below
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        # ---- mesh-spec-arity -------------------------------------- --
+        for fname, infos in by_name.items():
+            fns = defs.get(fname, [])
+            if len(fns) != 1:
+                continue
+            yield from self._check_arity(src, fns[0], fname, infos)
+        for lam, info in wrapped_lambdas:
+            yield from self._check_arity(src, lam, "<lambda>", [info])
+
+        # ---- body context: fn node -> wrapping info ----------------- --
+        body_ctx: Dict[int, Tuple[Set[str], bool]] = {}
+        for fname, infos in by_name.items():
+            fns = defs.get(fname, [])
+            if len(fns) != 1:
+                continue
+            axes: Set[str] = set()
+            complete = len(infos) == 1
+            for info in infos:
+                axes |= info.axes
+                complete = complete and info.complete
+            body_ctx[id(fns[0])] = (axes, complete)
+        for lam, info in wrapped_lambdas:
+            body_ctx[id(lam)] = (set(info.axes), info.complete)
+
+        # ---- collectives ------------------------------------------- --
+        # track the innermost enclosing shard_map-wrapped fn while
+        # walking with scopes (nested defs inherit the body context)
+        yield from self._check_collectives(src, body_ctx, vocab_values,
+                                           vocab_roles)
+
+    def _check_arity(self, src: SourceFile, fn: ast.AST, fname: str,
+                     infos: List[_ShardMapInfo]) -> Iterable[Finding]:
+        arity = _positional_arity(fn)
+        if arity is None:
+            return
+        lo, hi = arity
+        for info in infos:
+            spec = info.in_specs
+            if not isinstance(spec, (ast.Tuple, ast.List)):
+                continue  # single-spec prefix or computed: no claim
+            if any(isinstance(e, ast.Starred) for e in spec.elts):
+                continue
+            n = len(spec.elts)
+            if not (lo <= n <= hi):
+                want = (str(hi) if lo == hi
+                        else f"between {lo} and {hi}")
+                yield Finding(
+                    "mesh-spec-arity", "error", src.rel,
+                    spec.lineno,
+                    f"shard_map wraps '{fname}' with {n} in_specs "
+                    f"but its signature takes {want} positional "
+                    "argument(s); the mapped call passes exactly one "
+                    "operand per spec")
+
+    def _check_collectives(self, src: SourceFile,
+                           body_ctx: Dict[int, Tuple[Set[str], bool]],
+                           vocab_values: Set[str],
+                           vocab_roles: Set[str]) -> Iterable[Finding]:
+        have_vocab = bool(vocab_values or vocab_roles)
+        # enclosing wrapped-body context per node: recompute by walking
+        # parents via a stack of (node, ctx)
+        ctx_of_node: Dict[int, Tuple[Set[str], bool]] = {}
+
+        def paint(node: ast.AST, ctx: Optional[Tuple[Set[str], bool]]):
+            here = body_ctx.get(id(node), ctx)
+            if here is not None:
+                ctx_of_node[id(node)] = here
+            for child in ast.iter_child_nodes(node):
+                paint(child, here)
+
+        paint(src.tree, None)
+
+        for node, chain in walk_with_scopes(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            axis_expr = _axis_arg(node)
+            if axis_expr is None:
+                continue
+            cname = _call_name(node.func)
+            values = chain.resolve_strings(axis_expr)
+            if values is None:
+                continue  # unresolvable: conservative, no claim
+            ctx = ctx_of_node.get(id(node))
+            bound = ctx[0] if ctx else set()
+            complete = ctx[1] if ctx else False
+            for v in sorted(values, key=repr):
+                if v is None:
+                    continue
+                if isinstance(v, ConfigAxis):
+                    if vocab_roles and v.role not in vocab_roles:
+                        yield Finding(
+                            "mesh-axis-unbound", "error", src.rel,
+                            node.lineno,
+                            f"collective '{cname}' uses config_axis"
+                            f"('{v.role}') but no zoo.mesh.axis."
+                            f"{v.role} key is declared (known roles: "
+                            f"{', '.join(sorted(vocab_roles))})")
+                    continue
+                if have_vocab and v not in vocab_values | bound:
+                    yield Finding(
+                        "mesh-axis-unbound", "error", src.rel,
+                        node.lineno,
+                        f"collective '{cname}' over axis '{v}': no "
+                        "zoo.mesh.axis.* default, *_AXIS constant, or "
+                        "enclosing shard_map spec declares that axis "
+                        "name (typo, or declare the axis)")
+                elif (complete and bound and v not in bound
+                      and v in vocab_values):
+                    yield Finding(
+                        "mesh-unsharded-axis", "warning", src.rel,
+                        node.lineno,
+                        f"collective '{cname}' reduces over axis "
+                        f"'{v}' but the enclosing shard_map specs "
+                        f"only shard {sorted(bound)}; the operand is "
+                        "replicated over that axis (psum would "
+                        "multiply by its size)")
+            # nested collective over the same axis
+            single = (next(iter(values))
+                      if len(values) == 1 else None)
+            if isinstance(single, str):
+                for sub in ast.walk(
+                        node.args[0] if node.args else axis_expr):
+                    if (isinstance(sub, ast.Call) and sub is not node
+                            and _call_name(sub.func) in _COLLECTIVES):
+                        sub_axis = _axis_arg(sub)
+                        if sub_axis is None:
+                            continue
+                        sub_vals = chain.resolve_strings(sub_axis)
+                        if sub_vals == frozenset([single]):
+                            yield Finding(
+                                "mesh-nested-collective", "warning",
+                                src.rel, node.lineno,
+                                f"collective '{cname}' over axis "
+                                f"'{single}' already contains a "
+                                f"'{_call_name(sub.func)}' over the "
+                                "same axis (double reduction)")

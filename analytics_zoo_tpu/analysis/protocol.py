@@ -1,0 +1,394 @@
+"""Checker family 7: serving wire-protocol contracts (shardcheck).
+
+The serving wire carries two out-of-band vocabularies as plain
+strings: reserved blob keys (``__uri__``, ``__trace__``,
+``__deadline__``, ...) and structured error-reply prefixes
+(``deadline_exceeded:``, ``circuit_open:``). Both have exactly ONE
+declaring module -- ``serving/protocol.py`` -- found structurally as
+the module assigning ``WIRE_KEYS`` (a tuple of dunder strings) and
+``ERROR_PREFIXES`` (the prefix -> HTTP-status dict), so fixture
+projects work. A hand-typed copy anywhere else in ``serving/`` is
+either a typo that fails only under load (a mistyped ``__deadlin__``
+never expires anything) or vocabulary drift waiting to typo.
+
+Rules (scoped to ``serving/``; docstrings and event-type arguments --
+their own vocabulary, checked by the ``vocabulary`` family -- are
+exempt):
+
+``wire-key-literal`` (error)
+    A dunder string literal outside the declaring module: a
+    hand-typed copy of a reserved key (import the constant) or an
+    unknown reserved-looking key (typo). Python's own dunders
+    (``__main__`` etc.) are whitelisted.
+
+``error-prefix-literal`` (error)
+    A string literal outside the declaring module equal to a declared
+    prefix or building a ``<prefix>: ...`` message inline -- the
+    constant exists precisely so grep and the frontend agree.
+
+``error-prefix-unknown`` (error)
+    ``<expr>.startswith("<snake_case>")`` on a prefix-shaped literal
+    (or a name resolving to one -- the dataflow layer follows one
+    level of indirection) that no declaring module declares but that
+    *near-matches* a declared prefix (close edit distance): a typo'd
+    frontend mapping for a prefix no worker emits. The near-match
+    gate keeps ordinary scheme sniffing
+    (``backend.startswith("redis")``) out of scope.
+
+``error-prefix-unmapped`` (warning)
+    A declared ``*_PREFIX`` constant missing from ``ERROR_PREFIXES``
+    (the frontend cannot map it to an HTTP status -- the failure
+    class ships half-wired) or never referenced outside the declaring
+    module (nobody emits it).
+
+``protocol-vocab-module`` (error)
+    Wire-key or error-prefix constants declared outside the declaring
+    module: a second vocabulary home fragments the namespace exactly
+    the way cross-module metric registration fragments families.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from analytics_zoo_tpu.analysis.core import (
+    Checker, Finding, Project, SourceFile, register)
+from analytics_zoo_tpu.analysis.dataflow import module_chain
+
+_DUNDER_RE = re.compile(r"^__[a-z][a-z0-9_]*__$")
+_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# python-idiom dunders that are not wire keys
+_PY_DUNDERS = frozenset((
+    "__main__", "__name__", "__init__", "__file__", "__doc__",
+    "__all__", "__dict__", "__class__", "__module__", "__qualname__",
+    "__version__", "__spec__", "__path__", "__slots__", "__len__",
+    "__call__", "__enter__", "__exit__", "__getattr__", "__setattr__",
+    "__delattr__", "__getitem__", "__setitem__", "__iter__",
+    "__next__", "__repr__", "__str__", "__hash__", "__eq__",
+    "__builtins__", "__loader__", "__package__", "__new__", "__del__",
+))
+
+
+def _top_level_assigns(src: SourceFile):
+    for node in src.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        value = getattr(node, "value", None)
+        for t in targets:
+            if isinstance(t, ast.Name) and value is not None:
+                yield t.id, value, node.lineno
+
+
+def _dunder_tuple(value: ast.AST,
+                  chain=None) -> Optional[List[str]]:
+    """Tuple/list of dunder strings -- literal, or (with a module
+    ``chain``) names resolving to dunder-string constants, the
+    declaring module's own ``WIRE_KEYS = (URI_KEY, ...)`` idiom."""
+    if not isinstance(value, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for e in value.elts:
+        v = None
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            v = e.value
+        elif chain is not None and isinstance(e, ast.Name):
+            resolved = chain.resolve_strings(e)
+            if resolved and len(resolved) == 1:
+                (candidate,) = resolved
+                if isinstance(candidate, str):
+                    v = candidate
+        if v is None or not _DUNDER_RE.match(v):
+            return None
+        out.append(v)
+    return out or None
+
+
+def _near_prefix(candidate: str, declared) -> bool:
+    """True when ``candidate`` plausibly MEANS one of the declared
+    prefixes (close edit distance) -- the unknown-prefix rule targets
+    typo'd mappings, not every snake-case startswith in serving/
+    (scheme sniffing like ``backend.startswith("redis")`` must never
+    fire)."""
+    import difflib
+
+    for known in declared:
+        if difflib.SequenceMatcher(None, candidate,
+                                   known).ratio() >= 0.75:
+            return True
+    return False
+
+
+def _is_emit_arg0(node: ast.Constant, parents: Dict[int, ast.AST]
+                  ) -> bool:
+    parent = parents.get(id(node))
+    if not isinstance(parent, ast.Call) or not parent.args:
+        return False
+    if parent.args[0] is not node:
+        return False
+    func = parent.func
+    fname = (func.id if isinstance(func, ast.Name)
+             else func.attr if isinstance(func, ast.Attribute) else "")
+    return fname in ("emit", "emit_event")
+
+
+@register
+class ProtocolChecker(Checker):
+    name = "protocol"
+    rules = {
+        "wire-key-literal": "hand-typed dunder wire-key literal in "
+                            "serving/ outside the declaring module "
+                            "(typo, or import the constant)",
+        "error-prefix-literal": "structured error prefix built inline "
+                                "instead of from the declaring "
+                                "module's constant",
+        "error-prefix-unknown": "startswith() on a string near-"
+                                "matching a declared error prefix "
+                                "that no module declares (typo'd "
+                                "mapping for a prefix nobody emits)",
+        "error-prefix-unmapped": "declared error prefix missing from "
+                                 "ERROR_PREFIXES (no HTTP mapping) or "
+                                 "never referenced outside its "
+                                 "declaring module (never emitted)",
+        "protocol-vocab-module": "wire-key/error-prefix constants "
+                                 "declared outside the one declaring "
+                                 "module",
+    }
+
+    def __init__(self, restrict_dirs: Optional[Tuple[str, ...]]
+                 = ("serving",)):
+        self._restrict = restrict_dirs
+
+    def _in_scope(self, src: SourceFile) -> bool:
+        if self._restrict is None:
+            return True
+        parts = src.rel.split("/")
+        return any(d in parts[:-1] for d in self._restrict)
+
+    # ------------------------------------------------------ discovery --
+    @staticmethod
+    def _find_homes(files) -> Tuple[Optional[SourceFile],
+                                    Optional[SourceFile]]:
+        wire_home = prefix_home = None
+        for src in files:
+            chain = module_chain(src.tree)
+            for name, value, _line in _top_level_assigns(src):
+                if (name in ("WIRE_KEYS", "_META_KEYS")
+                        and _dunder_tuple(value, chain)
+                        and wire_home is None):
+                    wire_home = src
+                if (name == "ERROR_PREFIXES"
+                        and isinstance(value, ast.Dict)
+                        and prefix_home is None):
+                    prefix_home = src
+        return wire_home, prefix_home
+
+    @staticmethod
+    def _declared_keys(src: SourceFile) -> Set[str]:
+        keys: Set[str] = set()
+        chain = module_chain(src.tree)
+        for name, value, _line in _top_level_assigns(src):
+            tup = _dunder_tuple(value, chain)
+            if name in ("WIRE_KEYS", "_META_KEYS") and tup:
+                keys.update(tup)
+            elif (name.endswith("_KEY")
+                  and isinstance(value, ast.Constant)
+                  and isinstance(value.value, str)
+                  and _DUNDER_RE.match(value.value)):
+                keys.add(value.value)
+        return keys
+
+    @staticmethod
+    def _declared_prefixes(src: SourceFile
+                           ) -> Tuple[Dict[str, str], Set[str]]:
+        """({prefix value: constant name}, mapped prefix values) from
+        the declaring module's ``*_PREFIX`` constants and the
+        ``ERROR_PREFIXES`` dict (keys resolved through module-level
+        constants)."""
+        chain = module_chain(src.tree)
+        consts: Dict[str, str] = {}
+        mapped: Set[str] = set()
+        for name, value, _line in _top_level_assigns(src):
+            if (name.endswith("_PREFIX")
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)
+                    and _PREFIX_RE.match(value.value)):
+                consts[value.value] = name
+            elif name == "ERROR_PREFIXES" and isinstance(value,
+                                                         ast.Dict):
+                for k in value.keys:
+                    if k is None:
+                        continue
+                    resolved = chain.resolve_strings(k)
+                    if resolved:
+                        mapped.update(v for v in resolved
+                                      if isinstance(v, str))
+        return consts, mapped
+
+    # ---------------------------------------------------------- check --
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        scoped = [s for s in project.files if self._in_scope(s)]
+        if not scoped:
+            return
+        wire_home, prefix_home = self._find_homes(scoped)
+        wire_keys = (self._declared_keys(wire_home)
+                     if wire_home else set())
+        prefix_consts, mapped = (
+            self._declared_prefixes(prefix_home)
+            if prefix_home else ({}, set()))
+
+        # -- declaration-side contract checks ------------------------ --
+        if prefix_home is not None:
+            for value, cname in sorted(prefix_consts.items()):
+                if value not in mapped:
+                    yield Finding(
+                        "error-prefix-unmapped", "warning",
+                        prefix_home.rel, 0,
+                        f"error prefix {cname} ('{value}') is not a "
+                        "key of ERROR_PREFIXES: the frontend cannot "
+                        "map it to an HTTP status")
+
+        # -- use-site scans ------------------------------------------ --
+        prefix_refs: Set[str] = set()  # constant names referenced
+        for src in scoped:
+            is_wire_home = src is wire_home
+            is_prefix_home = src is prefix_home
+            parents: Dict[int, ast.AST] = {}
+            for node in ast.walk(src.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[id(child)] = node
+            chain = module_chain(src.tree)
+            for node in ast.walk(src.tree):
+                if (isinstance(node, (ast.Name, ast.Attribute))
+                        and not is_prefix_home):
+                    ref = (node.id if isinstance(node, ast.Name)
+                           else node.attr)
+                    if ref in prefix_consts.values():
+                        prefix_refs.add(ref)
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str):
+                    if src.is_docstring(node) or _is_emit_arg0(
+                            node, parents):
+                        continue
+                    yield from self._check_literal(
+                        src, node, is_wire_home, is_prefix_home,
+                        wire_home, wire_keys, prefix_consts)
+                elif isinstance(node, ast.Call):
+                    yield from self._check_startswith(
+                        src, node, chain, is_prefix_home,
+                        prefix_consts)
+            if not (is_wire_home and is_prefix_home):
+                yield from self._check_vocab_module(
+                    src, chain, is_wire_home, is_prefix_home,
+                    wire_home, prefix_home)
+
+        for value, cname in sorted(prefix_consts.items()):
+            if prefix_home is not None and cname not in prefix_refs:
+                yield Finding(
+                    "error-prefix-unmapped", "warning",
+                    prefix_home.rel, 0,
+                    f"error prefix {cname} ('{value}') is declared "
+                    "but never referenced outside its declaring "
+                    "module: nobody emits or maps it")
+
+    def _check_literal(self, src: SourceFile, node: ast.Constant,
+                       is_wire_home: bool, is_prefix_home: bool,
+                       wire_home, wire_keys: Set[str],
+                       prefix_consts: Dict[str, str]
+                       ) -> Iterable[Finding]:
+        value = node.value
+        if (_DUNDER_RE.match(value) and value not in _PY_DUNDERS
+                and not is_wire_home and wire_keys):
+            if value in wire_keys:
+                yield Finding(
+                    "wire-key-literal", "error", src.rel, node.lineno,
+                    f"hand-typed copy of reserved wire key '{value}'; "
+                    f"import the constant from {wire_home.rel}")
+            else:
+                near = ", ".join(sorted(wire_keys))
+                yield Finding(
+                    "wire-key-literal", "error", src.rel, node.lineno,
+                    f"'{value}' looks like a reserved wire key but "
+                    f"none is declared with that name (typo? known: "
+                    f"{near})")
+            return
+        if is_prefix_home or not prefix_consts:
+            return
+        for pvalue, cname in prefix_consts.items():
+            if value == pvalue or value.startswith(pvalue + ":") \
+                    or value.startswith(pvalue + " "):
+                yield Finding(
+                    "error-prefix-literal", "error", src.rel,
+                    node.lineno,
+                    f"error prefix '{pvalue}' built inline; use the "
+                    f"{cname} constant so the frontend mapping and "
+                    "grep stay in sync")
+                return
+
+    def _check_startswith(self, src: SourceFile, node: ast.Call,
+                          chain, is_prefix_home: bool,
+                          prefix_consts: Dict[str, str]
+                          ) -> Iterable[Finding]:
+        if is_prefix_home or not prefix_consts:
+            return
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr == "startswith" and node.args):
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant):
+            # a literal DECLARED prefix is _check_literal's finding;
+            # a literal prefix-shaped typo is still unknown-prefix
+            values = (frozenset([arg.value])
+                      if isinstance(arg.value, str) else None)
+        else:
+            values = chain.resolve_strings(arg)
+        if not values:
+            return
+        for v in sorted(v for v in values if isinstance(v, str)):
+            base = v[:-1] if v.endswith(":") else v
+            if (_PREFIX_RE.match(base) and base not in prefix_consts
+                    and _near_prefix(base, prefix_consts)):
+                yield Finding(
+                    "error-prefix-unknown", "error", src.rel,
+                    node.lineno,
+                    f"startswith() maps error prefix '{base}' but no "
+                    "declaring module declares it (known: "
+                    f"{', '.join(sorted(prefix_consts))}) -- a typo "
+                    "here silently downgrades structured errors")
+
+    def _check_vocab_module(self, src: SourceFile, chain,
+                            is_wire_home: bool,
+                            is_prefix_home: bool, wire_home,
+                            prefix_home) -> Iterable[Finding]:
+        for name, value, line in _top_level_assigns(src):
+            dunder_const = (isinstance(value, ast.Constant)
+                            and isinstance(value.value, str)
+                            and _DUNDER_RE.match(value.value)
+                            and value.value not in _PY_DUNDERS)
+            if (not is_wire_home and wire_home is not None
+                    and (name in ("WIRE_KEYS", "_META_KEYS")
+                         and _dunder_tuple(value, chain)
+                         or name.endswith("_KEY") and dunder_const)):
+                yield Finding(
+                    "protocol-vocab-module", "error", src.rel, line,
+                    f"wire-key constant '{name}' declared outside "
+                    f"the declaring module ({wire_home.rel}); one "
+                    "vocabulary home only")
+            elif (not is_prefix_home and prefix_home is not None
+                  and (name == "ERROR_PREFIXES"
+                       and isinstance(value, ast.Dict)
+                       or name.endswith("_PREFIX")
+                       and isinstance(value, ast.Constant)
+                       and isinstance(value.value, str)
+                       and _PREFIX_RE.match(value.value))):
+                yield Finding(
+                    "protocol-vocab-module", "error", src.rel, line,
+                    f"error-prefix constant '{name}' declared outside "
+                    f"the declaring module ({prefix_home.rel}); one "
+                    "vocabulary home only")

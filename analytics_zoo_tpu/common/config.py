@@ -137,6 +137,132 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.compile_cache.min_compile_secs": 2.0,
 }
 
+# Per-key type/range metadata (the glossary's machine-readable half,
+# docs/runtime.md "Config-key glossary"). Shapes:
+#
+#   ("int", lo, hi)      integer; lo/hi are inclusive bounds, None =
+#                        unbounded on that side
+#   ("float", lo, hi)    float (an int literal is acceptable)
+#   ("bool",)            strict boolean
+#   ("str",)             free-form string
+#   ("enum", a, b, ...)  one of the listed strings
+#
+# Consumed two ways: ``validate_config_value`` at runtime (opt-in;
+# ``set()`` stays permissive so tests can probe edge values) and the
+# zoolint ``config-type`` rule statically -- a ``get``/``set`` call
+# site whose cast or literal default contradicts the declared
+# type/range is a finding before it ships.
+_SPECS: Dict[str, tuple] = {
+    "zoo.train.failure.retry_times": ("int", 0, None),
+    "zoo.train.failure.retry_interval_s": ("float", 0, None),
+    "zoo.train.log_every_n_steps": ("int", 1, None),
+    "zoo.train.donate_buffers": ("bool",),
+    "zoo.train.prng_impl": ("str",),   # "auto"/"rbg"/"threefry2x32"/
+                                       # any jax.random.key impl name
+    "zoo.mesh.axis.data": ("str",),
+    "zoo.mesh.axis.model": ("str",),
+    "zoo.mesh.axis.sequence": ("str",),
+    "zoo.mesh.axis.pipeline": ("str",),
+    "zoo.mesh.axis.expert": ("str",),
+    "zoo.ops.attention_impl": ("enum", "auto", "flash", "einsum"),
+    "zoo.ops.attention_flash_min_seq": ("int", 0, None),
+    "zoo.ops.ring_schedule": ("enum", "auto", "zigzag", "contiguous"),
+    "zoo.models.bn_stat_rows": ("int", 0, None),
+    "zoo.data.prefetch_buffer": ("int", 0, None),
+    "zoo.data.check_batch_divisible": ("bool",),
+    "zoo.serving.batch_size": ("int", 1, None),
+    "zoo.serving.batch_timeout_ms": ("float", 0, None),
+    "zoo.serving.batch_timeout_min_ms": ("float", 0, None),
+    "zoo.serving.batch_max_size": ("int", 0, None),
+    "zoo.serving.pipeline.enabled": ("bool",),
+    "zoo.serving.pipeline.depth": ("int", 1, None),
+    "zoo.serving.http_port": ("int", 0, 65535),
+    "zoo.serving.supervisor.enabled": ("bool",),
+    "zoo.serving.supervisor.poll_interval_s": ("float", 0, None),
+    "zoo.serving.supervisor.heartbeat_timeout_s": ("float", 0, None),
+    "zoo.serving.supervisor.backoff_base_s": ("float", 0, None),
+    "zoo.serving.supervisor.backoff_max_s": ("float", 0, None),
+    "zoo.serving.supervisor.max_restarts": ("int", 0, None),
+    "zoo.serving.breaker.enabled": ("bool",),
+    "zoo.serving.breaker.threshold": ("int", 1, None),
+    "zoo.serving.breaker.cooldown_s": ("float", 0, None),
+    "zoo.serving.deadline_ms": ("float", 0, None),
+    "zoo.serving.shed.queue_depth": ("int", 0, None),
+    "zoo.serving.shed.retry_after_s": ("float", 0, None),
+    "zoo.serving.chaos.enabled": ("bool",),
+    "zoo.serving.chaos.seed": ("int", None, None),
+    "zoo.serving.chaos.spec": ("str",),
+    "zoo.obs.trace.enabled": ("bool",),
+    "zoo.obs.trace.max_spans": ("int", 1, None),
+    "zoo.obs.report.interval": ("float", 0, None),
+    "zoo.obs.events.max_events": ("int", 1, None),
+    "zoo.obs.flight.enabled": ("bool",),
+    "zoo.obs.postmortem.dir": ("str",),
+    "zoo.obs.postmortem.max_events": ("int", 1, None),
+    "zoo.obs.recompile.window_s": ("float", 0, None),
+    "zoo.obs.recompile.threshold": ("int", 1, None),
+    "zoo.inference.default_dtype": ("str",),
+    "zoo.compile_cache.dir": ("str",),
+    "zoo.compile_cache.min_compile_secs": ("float", 0, None),
+}
+
+
+def config_spec(key: str) -> Optional[tuple]:
+    """The declared (type, *constraints) spec for ``key``, or None."""
+    return _SPECS.get(key)
+
+
+def spec_violation(spec: tuple, value: Any) -> Optional[str]:
+    """Why ``value`` violates ``spec``, or None when it satisfies it.
+
+    THE single implementation of the spec semantics: the runtime
+    validators below and zoolint's ``config-type`` rule both call
+    this, so lint and launch-time validation cannot drift apart."""
+    kind = spec[0]
+    if kind == "bool":
+        if not isinstance(value, bool):
+            return f"wants bool, got {value!r}"
+    elif kind in ("int", "float"):
+        ok_types = (int,) if kind == "int" else (int, float)
+        if isinstance(value, bool) or not isinstance(value, ok_types):
+            return f"wants {kind}, got {value!r}"
+        lo = spec[1] if len(spec) > 1 else None
+        hi = spec[2] if len(spec) > 2 else None
+        if lo is not None and value < lo:
+            return f"wants >= {lo}, got {value!r}"
+        if hi is not None and value > hi:
+            return f"wants <= {hi}, got {value!r}"
+    elif kind == "str":
+        if not isinstance(value, str):
+            return f"wants str, got {value!r}"
+    elif kind == "enum":
+        if value not in spec[1:]:
+            return f"wants one of {spec[1:]}, got {value!r}"
+    return None
+
+
+def validate_config_value(key: str, value: Any) -> Any:
+    """Check ``value`` against the key's declared spec; returns the
+    value unchanged, raising ValueError on a violation. Keys without
+    a spec pass through (unknown keys are ``config-undeclared``'s
+    business, not this helper's)."""
+    spec = _SPECS.get(key)
+    if spec is not None:
+        why = spec_violation(spec, value)
+        if why:
+            raise ValueError(f"{key} {why}")
+    return value
+
+
+def validate_config(config: Optional["ZooConfig"] = None) -> None:
+    """Validate every spec'd key's *resolved* value (defaults + file +
+    env + overrides). Call at launch to fail fast on a bad conf file
+    or AZT_* env var instead of mid-serve."""
+    cfg = config if config is not None else get_config()
+    for key in _SPECS:
+        validate_config_value(key, cfg.get(key))
+
+
 _ENV_PREFIX = "AZT_"
 
 

@@ -10,12 +10,14 @@ M + S - 1 ticks (the classic bubble).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel.mesh import config_axis
 
 
 def _pipeline_local(stage_params, microbatches, rng, stage_fn,
@@ -81,9 +83,12 @@ def _pipeline_local(stage_params, microbatches, rng, stage_fn,
 
 def pipeline_apply(stage_fn: Callable[..., jnp.ndarray],
                    stacked_params: Any, microbatches: jnp.ndarray,
-                   mesh: Mesh, axis_name: str = "pipe",
+                   mesh: Mesh, axis_name: Optional[str] = None,
                    data_axis: str = None, rng=None) -> jnp.ndarray:
-    """Run ``stage_fn`` as an S-stage pipeline over the ``axis_name`` axis.
+    """Run ``stage_fn`` as an S-stage pipeline over the ``axis_name``
+    axis (default: the ``zoo.mesh.axis.pipeline`` config key ->
+    ``"pipe"``, so a deployment that renames its pipeline axis sets
+    one key instead of threading the name through every call).
 
     Args:
       stage_fn: (stage_params, activation [*mb_shape]) -> activation; must
@@ -101,6 +106,8 @@ def pipeline_apply(stage_fn: Callable[..., jnp.ndarray],
 
     Returns [M, *mb_shape]: outputs of the final stage per microbatch.
     """
+    if axis_name is None:
+        axis_name = config_axis("pipeline", fallback="pipe")
     n_microbatches = microbatches.shape[0]
     param_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name), stacked_params)
@@ -122,7 +129,8 @@ def pipeline_train_step(stage_fn: Callable[[Any, jnp.ndarray],
                                            jnp.ndarray],
                         loss_fn: Callable[[jnp.ndarray, jnp.ndarray],
                                           jnp.ndarray],
-                        tx, mesh: Mesh, axis_name: str = "pipe",
+                        tx, mesh: Mesh,
+                        axis_name: Optional[str] = None,
                         data_axis: str = None):
     """Build a jitted pipeline-parallel TRAINING step.
 

@@ -129,7 +129,10 @@ def _ring_shard_call(local_fn, q, k, v, mesh, axis_name, qkv_spec,
     detects the batch-sharding axis (per-shard dropout keys), builds
     the shard_map and threads the optional rng operand."""
     if qkv_spec is None:
-        data = "data" if "data" in mesh.axis_names else None
+        # batch dim shards over the configured data axis when the mesh
+        # carries it (zoo.mesh.axis.data; reconciled, not hard-coded)
+        data_ax = config_axis("data")
+        data = data_ax if data_ax in mesh.axis_names else None
         qkv_spec = P(data, axis_name, None, None)
     dropping = dropout_rng is not None and dropout_rate > 0.0
     batch_axis = qkv_spec[0] if len(qkv_spec) > 0 else None

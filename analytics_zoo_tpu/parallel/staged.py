@@ -36,7 +36,8 @@ import numpy as np
 from jax import lax
 
 from analytics_zoo_tpu.keras.layers.transformer import TransformerBlock
-from analytics_zoo_tpu.parallel.mesh import default_mesh, mesh_axis_size
+from analytics_zoo_tpu.parallel.mesh import (
+    config_axis, default_mesh, mesh_axis_size)
 from analytics_zoo_tpu.parallel.pipeline import pipeline_apply
 
 
@@ -124,10 +125,14 @@ class PipelinedTransformerLM:
         blocks = p["blocks"]
         b = h.shape[0]
         mesh = self._mesh()
-        pipe = (mesh_axis_size(mesh, "pipe")
-                if "pipe" in mesh.axis_names else 1)
-        data = (mesh_axis_size(mesh, "data")
-                if "data" in mesh.axis_names else 1)
+        # axis names reconciled against zoo.mesh.axis.* (a deployment
+        # renaming its pipe/data axes sets the config, not this file)
+        pipe_axis = config_axis("pipeline", fallback="pipe")
+        dp_axis = config_axis("data")
+        pipe = (mesh_axis_size(mesh, pipe_axis)
+                if pipe_axis in mesh.axis_names else 1)
+        data = (mesh_axis_size(mesh, dp_axis)
+                if dp_axis in mesh.axis_names else 1)
         m = self.n_microbatches
         use_pipe = (pipe > 1 and self.n_block % pipe == 0
                     and b % m == 0 and (b // m) % data == 0)
@@ -138,7 +143,7 @@ class PipelinedTransformerLM:
             stage_params = jax.tree_util.tree_map(
                 lambda a: a.reshape((pipe, bps) + a.shape[1:]), blocks)
             mb = h.reshape((m, b // m) + h.shape[1:])
-            data_axis = "data" if data > 1 else None
+            data_axis = dp_axis if data > 1 else None
 
             def stage_fn(sp, a, *ctx):
                 # ctx = (mb_idx, stage_id, key) when pipeline_apply got
@@ -162,7 +167,7 @@ class PipelinedTransformerLM:
                 return out
 
             out = pipeline_apply(
-                stage_fn, stage_params, mb, mesh, axis_name="pipe",
+                stage_fn, stage_params, mb, mesh, axis_name=pipe_axis,
                 data_axis=data_axis, rng=rng if dropout else None)
             h = out.reshape((b,) + h.shape[1:])
         elif dropout:

@@ -9,7 +9,9 @@ a micro-batcher with bounded backpressure, a serving worker around
 ``InferenceModel``, and a stdlib HTTP frontend with /predict + /metrics.
 Resilience (supervised restarts, circuit breaker, deadlines, load
 shedding) lives in ``resilience``; the deterministic fault-injection
-harness that proves it lives in ``chaos``.
+harness that proves it lives in ``chaos``. The wire vocabulary --
+reserved blob keys and structured error prefixes -- has ONE declaring
+module, ``protocol`` (lint-enforced by zoolint's protocol family).
 """
 
 from analytics_zoo_tpu.serving.queues import (  # noqa: F401
@@ -43,4 +45,9 @@ from analytics_zoo_tpu.serving.resilience import (  # noqa: F401
 from analytics_zoo_tpu.serving.chaos import (  # noqa: F401
     ChaosInjector,
     parse_spec,
+)
+from analytics_zoo_tpu.serving.protocol import (  # noqa: F401
+    ERROR_PREFIXES,
+    WIRE_KEYS,
+    error_status,
 )

@@ -44,8 +44,8 @@ from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.obs.events import get_event_log, to_jsonable
 from analytics_zoo_tpu.obs.flight import get_inflight
 from analytics_zoo_tpu.obs.metrics import get_registry
+from analytics_zoo_tpu.serving.protocol import ERROR_KEY, error_status
 from analytics_zoo_tpu.serving.timer import Timer
-from analytics_zoo_tpu.serving.worker import DEADLINE_PREFIX, ERROR_KEY
 
 logger = get_logger(__name__)
 
@@ -384,12 +384,15 @@ class HttpFrontend:
             return 504, {"error": "prediction timed out"}
         if ERROR_KEY in result:
             msg = str(result[ERROR_KEY])
-            if msg.startswith(DEADLINE_PREFIX):
-                # the worker's structured deadline rejection
-                # (zoo.serving.deadline_ms) is a timeout to the
-                # client, not a server fault
-                return 504, {"error": "deadline_exceeded",
-                             "detail": msg}
+            status = error_status(msg)
+            if status is not None:
+                # structured worker rejection (protocol.ERROR_PREFIXES):
+                # deadline_exceeded -> 504 (the client's budget ran
+                # out, not a server fault), circuit_open -> 503 (the
+                # handler adds Retry-After to every 503 so clients
+                # back off while the breaker cools down)
+                return status, {"error": msg.split(":", 1)[0],
+                                "detail": msg}
             return 500, {"error": msg}
         return 200, _to_jsonable(result)
 

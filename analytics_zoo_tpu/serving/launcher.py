@@ -122,6 +122,12 @@ def _load_model(cfg: Dict[str, Any]) -> InferenceModel:
 
 def launch(config: Dict[str, Any]) -> ServingApp:
     """Assemble and start a deployment from a parsed config dict."""
+    # fail fast on a bad conf file / AZT_* env var: every spec'd
+    # zoo.* key's resolved value is checked against the type/range
+    # metadata (common.config._SPECS) before any thread starts
+    from analytics_zoo_tpu.common.config import validate_config
+
+    validate_config()
     # black box first: a deployment that dies during model load /
     # warm-up should already leave a postmortem bundle. Library-level
     # install (no signal hook -- launch() may run off the main thread);

@@ -1,0 +1,71 @@
+"""Serving wire-protocol vocabulary: ONE declaring module.
+
+Everything that crosses the serving wire as an out-of-band *name* --
+reserved blob keys and structured error-reply prefixes -- is declared
+here and imported everywhere else. A hand-typed copy elsewhere in
+``serving/`` is a zoolint finding (``analysis/protocol.py``): a typo'd
+key silently drops a deadline on the floor and a prefix the frontend
+cannot map turns a structured rejection into a generic 500, and both
+only surface under load.
+
+Reserved wire keys (AZT1/npz blob tensor names; see
+``queues._encode``):
+
+- ``__uri__``       request id, the reply-correlation key
+- ``__reply__``     reply-to stream for brokered deployments
+- ``__trace__``     obs trace id riding the blob (zoo.obs.trace.*)
+- ``__deadline__``  absolute epoch-seconds deadline
+                    (zoo.serving.deadline_ms)
+- ``__error__``     reply-side: the structured error message tensor
+
+Structured error prefixes (the *class* of a failure rides the reply
+message as a greppable ``<prefix>: detail`` string, so the frontend
+can map it to an HTTP status without a second wire field):
+
+- ``deadline_exceeded`` -> 504 (the client's budget ran out; not a
+  server fault)
+- ``circuit_open`` -> 503 (breaker fast-fail; the handler adds
+  Retry-After to every 503 so clients back off)
+
+``ERROR_PREFIXES`` is the complete prefix -> HTTP-status contract;
+zoolint's ``error-prefix-unmapped`` rule fails any declared prefix
+missing from it, so a new failure class cannot ship half-wired.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# ---------------------------------------------------------- wire keys --
+URI_KEY = "__uri__"
+REPLY_KEY = "__reply__"
+TRACE_KEY = "__trace__"
+DEADLINE_KEY = "__deadline__"
+ERROR_KEY = "__error__"
+
+# request-side out-of-band keys the decoder strips from tensor dicts
+# (ERROR_KEY is reply-side only: model outputs named "error" stay
+# usable, and an error reply is recognised by ERROR_KEY's presence)
+WIRE_KEYS = (URI_KEY, REPLY_KEY, TRACE_KEY, DEADLINE_KEY)
+
+# ------------------------------------------------------ error prefixes --
+DEADLINE_PREFIX = "deadline_exceeded"
+CIRCUIT_PREFIX = "circuit_open"
+
+# prefix -> HTTP status the frontend answers with; prefixes absent
+# here fall through to 500 (generic server fault), which is exactly
+# what the zoolint contract rule exists to prevent for declared ones
+ERROR_PREFIXES = {
+    DEADLINE_PREFIX: 504,
+    CIRCUIT_PREFIX: 503,
+}
+
+
+def error_status(message: str) -> Optional[int]:
+    """HTTP status for a structured error reply, or None when the
+    message carries no declared prefix (-> generic 500 at the
+    frontend). Matches ``<prefix>`` exactly or ``<prefix>:``-led."""
+    for prefix, status in ERROR_PREFIXES.items():
+        if message == prefix or message.startswith(prefix + ":"):
+            return status
+    return None

@@ -27,6 +27,8 @@ import numpy as np
 from analytics_zoo_tpu.obs import tracing as _tracing
 from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.obs.metrics import get_registry as _get_registry
+from analytics_zoo_tpu.serving.protocol import (
+    DEADLINE_KEY, REPLY_KEY, TRACE_KEY, URI_KEY, WIRE_KEYS)
 
 # client-side data-plane counters (the queues' entry in the unified
 # registry): offered load, backpressure rejections, drained results
@@ -60,16 +62,16 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
             reply_to: Optional[str] = None,
             trace_id: Optional[str] = None,
             deadline: Optional[float] = None) -> bytes:
-    items = [("__uri__", np.asarray(uri))]
+    items = [(URI_KEY, np.asarray(uri))]
     if reply_to:
         # reply-to stream for brokered deployments: the worker that
         # serves the request routes the result back to the REQUESTER'S
         # result stream (several frontends can share one broker)
-        items.append(("__reply__", np.asarray(reply_to)))
+        items.append((REPLY_KEY, np.asarray(reply_to)))
     if trace_id:
         # end-to-end tracing (obs.tracing): the id rides the blob so
         # worker stages can span against it; absent when tracing is off
-        items.append(("__trace__", np.asarray(trace_id)))
+        items.append((TRACE_KEY, np.asarray(trace_id)))
     if deadline is not None:
         # absolute epoch-seconds deadline (zoo.serving.deadline_ms,
         # stamped at enqueue): the worker rejects expired requests at
@@ -77,7 +79,7 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
         # error. Wall-clock, not monotonic -- the blob may cross
         # processes/hosts, and skew only shifts the budget by clock
         # error, which deadline granularity (>= tens of ms) tolerates
-        items.append(("__deadline__", np.asarray(float(deadline))))
+        items.append((DEADLINE_KEY, np.asarray(float(deadline))))
     for k, v in payload.items():
         a = np.asarray(v)
         if not a.flags["C_CONTIGUOUS"]:
@@ -104,7 +106,7 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
     return b"".join(parts)
 
 
-_META_KEYS = ("__uri__", "__reply__", "__trace__", "__deadline__")
+_META_KEYS = WIRE_KEYS  # historical alias for the codec below
 
 
 def _decode(blob: bytes) -> Tuple[str, Dict[str, np.ndarray]]:
@@ -161,24 +163,24 @@ def _decode_request(blob: bytes
     deadline) with every meta key stripped from the tensor dict."""
     if blob[:4] == _MAGIC:
         z = _decode_raw(blob)
-        uri = str(z["__uri__"].reshape(())) if "__uri__" in z else ""
-        reply = (str(z["__reply__"].reshape(()))
-                 if "__reply__" in z else None)
-        trace = (str(z["__trace__"].reshape(()))
-                 if "__trace__" in z else None)
-        deadline = (float(z["__deadline__"].reshape(()))
-                    if "__deadline__" in z else None)
+        uri = str(z[URI_KEY].reshape(())) if URI_KEY in z else ""
+        reply = (str(z[REPLY_KEY].reshape(()))
+                 if REPLY_KEY in z else None)
+        trace = (str(z[TRACE_KEY].reshape(()))
+                 if TRACE_KEY in z else None)
+        deadline = (float(z[DEADLINE_KEY].reshape(()))
+                    if DEADLINE_KEY in z else None)
         return uri, {k: v for k, v in z.items()
                      if k not in _META_KEYS}, reply, trace, deadline
     if not blob.startswith(_ZIP_MAGIC):
         raise ValueError("not a serving wire blob (neither AZT1 nor "
                          "legacy npz framing)")
     with np.load(io.BytesIO(blob), allow_pickle=False) as z:  # legacy v1
-        uri = str(z["__uri__"])
-        reply = str(z["__reply__"]) if "__reply__" in z.files else None
-        trace = str(z["__trace__"]) if "__trace__" in z.files else None
-        deadline = (float(z["__deadline__"])
-                    if "__deadline__" in z.files else None)
+        uri = str(z[URI_KEY])
+        reply = str(z[REPLY_KEY]) if REPLY_KEY in z.files else None
+        trace = str(z[TRACE_KEY]) if TRACE_KEY in z.files else None
+        deadline = (float(z[DEADLINE_KEY])
+                    if DEADLINE_KEY in z.files else None)
         return uri, {k: z[k] for k in z.files
                      if k not in _META_KEYS}, reply, trace, deadline
 

@@ -47,6 +47,8 @@ from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.obs.tracing import get_tracer
 from analytics_zoo_tpu.serving.batcher import AdaptiveBatcher, MicroBatcher
 from analytics_zoo_tpu.serving.chaos import chaos_point
+from analytics_zoo_tpu.serving.protocol import (
+    CIRCUIT_PREFIX, DEADLINE_PREFIX, ERROR_KEY)
 from analytics_zoo_tpu.serving.queues import (
     TcpQueue, _decode_request, _encode)
 from analytics_zoo_tpu.serving.timer import Timer
@@ -83,15 +85,14 @@ _M_DEADLINE = _REG.counter(
     "Requests rejected for missing their zoo.serving.deadline_ms "
     "budget (the catching stage rides the error message/event)")
 
-ERROR_KEY = "__error__"
-
-# structured-error message prefixes: the error REPLY is a plain string
-# on the wire, so the class of failure rides as a greppable prefix --
-# the frontend maps deadline errors to 504, and _push_error picks the
-# right event/counter without a second argument threading through the
+# ERROR_KEY / DEADLINE_PREFIX / CIRCUIT_PREFIX are re-exported above
+# from serving.protocol -- the wire vocabulary's one declaring module
+# (zoolint's protocol family fails hand-typed copies); the error REPLY
+# is a plain string on the wire, so the class of failure rides as a
+# greppable prefix the frontend maps to an HTTP status
+# (protocol.ERROR_PREFIXES) and _push_error picks the right
+# event/counter from without a second argument threading through the
 # in-flight record tuples
-DEADLINE_PREFIX = "deadline_exceeded"
-CIRCUIT_PREFIX = "circuit_open"
 
 # compressed-image magic numbers: requests may ship JPEG/PNG bytes
 # instead of raw pixel tensors (the reference decodes base64 images

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Fast lint gate: zoolint over the package plus the two tier-1 test
+# modules that enforce its contracts (the zoolint gate itself and the
+# metric/event vocabulary lint). Runs in seconds -- wire it before the
+# full suite locally (pre-push) and first in CI so lint regressions
+# fail fast.
+#
+# Usage:
+#     scripts/check_tree.sh              # full package lint + gate tests
+#     scripts/check_tree.sh --changed    # sub-second pre-push loop:
+#                                        # lint only files changed vs HEAD
+#
+# Any extra arguments are forwarded to scripts/zoolint.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== zoolint =="
+python scripts/zoolint.py "$@"
+
+echo "== gate tests (test_zoolint, test_metric_names) =="
+python -m pytest tests/test_zoolint.py tests/test_metric_names.py \
+    -q -p no:cacheprovider

@@ -8,6 +8,8 @@ Usage:
     python scripts/zoolint.py --update-baseline    # grandfather current findings
     python scripts/zoolint.py --list-rules
     python scripts/zoolint.py --rules silent-except,lock-guard pkg/
+    python scripts/zoolint.py --changed            # only files changed vs HEAD
+    python scripts/zoolint.py --changed origin/main
 
 Exit status: 0 when every finding is baselined (or there are none);
 1 when any NEW finding exists; 2 on usage errors. The tier-1 test
@@ -17,6 +19,7 @@ Exit status: 0 when every finding is baselined (or there are none);
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -24,6 +27,28 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 DEFAULT_BASELINE = os.path.join(REPO, "zoolint_baseline.json")
+
+
+def _changed_files(ref: str):
+    """Absolute paths of .py files changed vs ``ref`` (tracked diff +
+    untracked), or None when git itself fails (not a repo, bad ref) --
+    the caller falls back to a full run rather than linting nothing."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "-z", ref],
+            cwd=REPO, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard", "-z"],
+            cwd=REPO, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    names = [n for out in (diff.stdout, untracked.stdout)
+             for n in out.split("\0") if n]
+    return sorted({os.path.join(REPO, n) for n in names
+                   if n.endswith(".py") and os.path.isfile(
+                       os.path.join(REPO, n))})
 
 
 def main(argv=None) -> int:
@@ -50,7 +75,44 @@ def main(argv=None) -> int:
                     help="comma-separated rule subset to run")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only .py files changed vs a git ref "
+                         "(default HEAD: working-tree edits + "
+                         "untracked). Project-wide ground truth is "
+                         "still read from the full tree; findings "
+                         "outside the changed files are dropped")
     args = ap.parse_args(argv)
+
+    def _nothing_changed(detail: str) -> int:
+        # the pre-push fast path: nothing to lint (none of the heavy
+        # imports below ever run). --json consumers still get the
+        # documented object shape, not a prose line.
+        if args.as_json:
+            print(json.dumps({
+                "findings": [], "new": [], "stale_baseline": [],
+                "counts": {"total": 0, "new": 0, "baselined": 0,
+                           "stale_baseline": 0},
+            }, indent=2, sort_keys=True))
+        else:
+            print(f"zoolint: {detail}; 0 finding(s), 0 new")
+        return 0
+
+    report_only = None
+    if args.changed is not None:
+        if args.update_baseline:
+            # a changed-files slice must not rewrite the baseline for
+            # the same reason a --rules slice must not
+            print("zoolint: --update-baseline requires a full run "
+                  "(drop --changed)", file=sys.stderr)
+            return 2
+        report_only = _changed_files(args.changed)
+        if report_only is None:
+            print("zoolint: --changed: git unavailable or bad ref; "
+                  "falling back to a full run", file=sys.stderr)
+        elif not report_only:
+            return _nothing_changed(
+                f"no python files changed vs {args.changed}")
 
     from analytics_zoo_tpu.analysis import all_rules, run_zoolint
     from analytics_zoo_tpu.analysis.baseline import (
@@ -70,6 +132,16 @@ def main(argv=None) -> int:
         return 2
 
     paths = args.paths or [os.path.join(REPO, "analytics_zoo_tpu")]
+    if report_only is not None:
+        # keep only changed files under the lint paths (a changed
+        # test/ script outside them is not this run's business)
+        roots = [os.path.abspath(p) for p in paths]
+        report_only = [f for f in report_only
+                       if any(f == r or f.startswith(r + os.sep)
+                              for r in roots)]
+        if not report_only:
+            return _nothing_changed(
+                f"no changed python files under {', '.join(paths)}")
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
     if rules:
@@ -78,7 +150,7 @@ def main(argv=None) -> int:
             print(f"zoolint: unknown rules: {', '.join(sorted(unknown))}",
                   file=sys.stderr)
             return 2
-    findings = run_zoolint(paths, rules=rules)
+    findings = run_zoolint(paths, rules=rules, report_only=report_only)
 
     baseline_path = args.baseline
     if baseline_path is None and not args.no_baseline:
@@ -96,7 +168,10 @@ def main(argv=None) -> int:
         return 0
 
     fresh = new_findings(findings, baseline)
-    stale = stale_entries(findings, baseline) if baseline else []
+    # a --changed slice cannot see findings outside its files, so it
+    # cannot judge staleness -- only the full run reports it
+    stale = (stale_entries(findings, baseline)
+             if baseline and report_only is None else [])
 
     if args.as_json:
         print(json.dumps({

@@ -541,6 +541,48 @@ class TestDeadlines:
         assert code == 504
         assert payload["error"] == "deadline_exceeded"
 
+    def test_frontend_maps_circuit_open_error_to_503(self):
+        """Breaker fast-fails are a retryable capacity condition, not
+        a server fault: the frontend maps the structured circuit_open
+        prefix to 503 via protocol.ERROR_PREFIXES (the do_POST handler
+        adds Retry-After to every 503)."""
+        from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
+        from analytics_zoo_tpu.serving.worker import CIRCUIT_PREFIX
+
+        in_q, out_q = InputQueue(), OutputQueue()
+        fe = HttpFrontend(in_q, out_q)
+        fe.router.register("u-cb")
+        out_q.queue.put(_encode(
+            "u-cb", {ERROR_KEY: np.asarray(
+                CIRCUIT_PREFIX + ": backend dispatch suspended "
+                                 "after repeated failures")}))
+        fe.router.start()
+        try:
+            code, payload = fe._await("u-cb",
+                                      time.monotonic() + 5.0)
+        finally:
+            fe.router.stop()
+            fe._server.server_close()
+        assert code == 503
+        assert payload["error"] == "circuit_open"
+
+    def test_error_status_contract(self):
+        """protocol.error_status: exact or '<prefix>:'-led matches
+        only -- a prefix-extending message must NOT inherit the
+        mapping, and unprefixed errors stay generic (None -> 500)."""
+        from analytics_zoo_tpu.serving.protocol import (
+            CIRCUIT_PREFIX, DEADLINE_PREFIX, ERROR_PREFIXES,
+            error_status)
+
+        assert error_status(DEADLINE_PREFIX) == 504
+        assert error_status(DEADLINE_PREFIX + ": detail") == 504
+        assert error_status(CIRCUIT_PREFIX + ": detail") == 503
+        assert error_status(DEADLINE_PREFIX + "_extra: x") is None
+        assert error_status("boom") is None
+        # every declared prefix carries a real HTTP status
+        assert all(isinstance(s, int) and 400 <= s < 600
+                   for s in ERROR_PREFIXES.values())
+
 
 # ------------------------------------------------------- load shedding --
 class TestLoadShedding:

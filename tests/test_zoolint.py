@@ -30,6 +30,8 @@ from analytics_zoo_tpu.analysis.concurrency import ConcurrencyChecker
 from analytics_zoo_tpu.analysis.config_keys import ConfigKeyChecker
 from analytics_zoo_tpu.analysis.core import all_rules
 from analytics_zoo_tpu.analysis.hygiene import HygieneChecker
+from analytics_zoo_tpu.analysis.mesh_rules import MeshCollectiveChecker
+from analytics_zoo_tpu.analysis.protocol import ProtocolChecker
 from analytics_zoo_tpu.analysis.trace_hazards import TraceHazardChecker
 from analytics_zoo_tpu.analysis.vocabulary import VocabularyChecker
 
@@ -512,10 +514,587 @@ class TestHygiene:
 
 
 # ===================================================================== #
+# dataflow layer (reaching definitions + constant propagation)          #
+# ===================================================================== #
+class TestDataflow:
+    def _chain_for_fn(self, code, fn_name):
+        import ast
+
+        from analytics_zoo_tpu.analysis.dataflow import walk_with_scopes
+        tree = ast.parse(textwrap.dedent(code))
+        for node, chain in walk_with_scopes(tree):
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name == fn_name):
+                return chain
+        raise AssertionError(f"no def {fn_name}")
+
+    @staticmethod
+    def _name(n):
+        import ast
+
+        return ast.Name(id=n, ctx=ast.Load())
+
+    def test_constant_propagation_through_locals_and_module(self):
+        chain = self._chain_for_fn("""
+            BASE = "zoo."
+            KEY = BASE + "mesh"
+
+            def f(flag):
+                axis = KEY
+                other = "a" if flag else "b"
+                return axis, other
+            """, "f")
+        assert chain.resolve(self._name("axis")) == frozenset(
+            ["zoo.mesh"])
+        assert chain.resolve(self._name("other")) == frozenset(
+            ["a", "b"])
+
+    def test_config_axis_indirection_resolves(self):
+        """THE acceptance case: ``axis = config_axis("tp")`` resolves
+        to a symbolic ConfigAxis('tp') at the use site."""
+        from analytics_zoo_tpu.analysis.dataflow import ConfigAxis
+        chain = self._chain_for_fn("""
+            def f(x):
+                axis = config_axis("tp")
+                return axis
+            """, "f")
+        assert chain.resolve(self._name("axis")) == frozenset(
+            [ConfigAxis("tp")])
+
+    def test_unknowns_stay_unknown(self):
+        """Params, loop targets, rebinding taints, and calls must all
+        resolve to None (the conservative contract every rule relies
+        on to avoid false positives)."""
+        chain = self._chain_for_fn("""
+            def f(param, items):
+                computed = len(items)
+                for loop_var in items:
+                    pass
+                multi = "a"
+                multi = compute()
+                return param
+            """, "f")
+        for name in ("param", "loop_var", "computed", "multi",
+                     "free_name"):
+            assert chain.resolve(self._name(name)) is None, name
+
+    def test_conflicting_reassignment_is_unknown(self):
+        """No statement ordering in the walk, so a name reassigned to
+        a DIFFERENT value must be unknown -- a union would let a later
+        unrelated string indict an earlier correct collective axis."""
+        chain = self._chain_for_fn("""
+            def f(x):
+                name = "model"
+                use(name)
+                name = "stage_done"
+                agreed = "a"
+                agreed = "a"
+                return name
+            """, "f")
+        assert chain.resolve(self._name("name")) is None
+        assert chain.resolve(self._name("agreed")) == frozenset(["a"])
+
+    def test_match_case_bindings_visible(self):
+        """match-case bodies belong to the enclosing scope: a dynamic
+        rebinding inside a case must make the name unknown, not let a
+        module constant shadow it (python 3.10+)."""
+        chain = self._chain_for_fn("""
+            axis = "data"
+
+            def f(mode):
+                match mode:
+                    case "a" as captured:
+                        axis = compute_axis()
+                    case _:
+                        pass
+                return axis
+            """, "f")
+        assert chain.resolve(self._name("axis")) is None
+        assert chain.resolve(self._name("captured")) is None
+
+    def test_fstring_folds_when_constant(self):
+        chain = self._chain_for_fn("""
+            ROLE = "model"
+
+            def f():
+                key = f"zoo.mesh.axis.{ROLE}"
+                return key
+            """, "f")
+        assert chain.resolve(self._name("key")) == frozenset(
+            ["zoo.mesh.axis.model"])
+
+
+# ===================================================================== #
+# family 6: mesh/collective correctness                                 #
+# ===================================================================== #
+MESH_CONFIG_FIXTURE = """
+_DEFAULTS = {
+    "zoo.mesh.axis.data": "data",
+    "zoo.mesh.axis.model": "model",
+}
+"""
+
+
+class TestMeshRules:
+    CHECKER = [MeshCollectiveChecker()]
+
+    def _project(self, tmp_path, code, name="par.py"):
+        (tmp_path / "common").mkdir(parents=True, exist_ok=True)
+        (tmp_path / "common" / "config.py").write_text(
+            MESH_CONFIG_FIXTURE)
+        (tmp_path / name).write_text(textwrap.dedent(code))
+        return run_zoolint([str(tmp_path)], checkers=self.CHECKER,
+                           repo_root=str(tmp_path))
+
+    def test_typod_axis_through_indirection_fires(self, tmp_path):
+        """Acceptance case: a typo'd axis name reaches the collective
+        through ONE level of variable indirection and still fires."""
+        fs = self._project(tmp_path, """
+            from jax import lax
+            import jax
+
+            def body(x):
+                name = "modle"
+                return lax.psum(x, name)
+
+            f = jax.shard_map(body, mesh=None, in_specs=(None,),
+                              out_specs=None)
+            """)
+        assert rules_of(fs) == ["mesh-axis-unbound"]
+        assert "modle" in fs[0].message
+
+    def test_declared_axis_and_unresolvable_do_not_fire(self, tmp_path):
+        """Declared axes pass; an axis held in a function parameter is
+        unresolvable and must never fire (collectives.py wrappers)."""
+        fs = self._project(tmp_path, """
+            from jax import lax
+
+            def all_reduce(x, axis_name):
+                return lax.psum(x, axis_name)
+
+            def body(x):
+                return lax.pmean(x, "model")
+            """)
+        assert fs == []
+
+    def test_reused_variable_after_collective_does_not_fire(
+            self, tmp_path):
+        """A name holding a valid axis at the psum and reused for an
+        unrelated string LATER must not fire: multi-assignment with
+        differing values resolves to unknown, never a union."""
+        fs = self._project(tmp_path, """
+            from jax import lax
+
+            def body(x, log):
+                name = "model"
+                r = lax.psum(x, name)
+                name = "stage_done"
+                log(name)
+                return r
+            """)
+        assert fs == []
+
+    def test_undeclared_config_axis_role_fires(self, tmp_path):
+        fs = self._project(tmp_path, """
+            from jax import lax
+
+            def body(x):
+                axis = config_axis("tensor")
+                return lax.psum(x, axis)
+            """)
+        assert rules_of(fs) == ["mesh-axis-unbound"]
+        assert "tensor" in fs[0].message
+
+    def test_declared_config_axis_role_does_not_fire(self, tmp_path):
+        fs = self._project(tmp_path, """
+            from jax import lax
+
+            def body(x):
+                axis = config_axis("model")
+                return lax.psum(x, axis)
+            """)
+        assert fs == []
+
+    def test_spec_arity_mismatch_fires_match_does_not(self, tmp_path):
+        fs = self._project(tmp_path, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def two_args(a, b):
+                return a + b
+
+            bad = jax.shard_map(two_args, mesh=None,
+                                in_specs=(P("data"),),
+                                out_specs=P())
+            good = jax.shard_map(two_args, mesh=None,
+                                 in_specs=(P("data"), P()),
+                                 out_specs=P())
+            """)
+        assert rules_of(fs) == ["mesh-spec-arity"]
+        assert len(fs) == 1 and "two_args" in fs[0].message
+
+    def test_partial_wrapped_fn_is_skipped(self, tmp_path):
+        """``shard_map(partial(fn, ...), ...)`` has an unknowable
+        effective signature -- never a finding (zouwu/ring idiom)."""
+        fs = self._project(tmp_path, """
+            import jax
+            from functools import partial
+            from jax.sharding import PartitionSpec as P
+
+            def fn(a, b, c):
+                return a
+
+            f = jax.shard_map(partial(fn, c=1), mesh=None,
+                              in_specs=(P(),), out_specs=P())
+            """)
+        assert fs == []
+
+    def test_unsharded_axis_fires_sharded_does_not(self, tmp_path):
+        fs = self._project(tmp_path, """
+            import jax
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            def body(x):
+                return lax.psum(x, "model")
+
+            bad = jax.shard_map(body, mesh=None,
+                                in_specs=(P("data", None),),
+                                out_specs=P("data", None))
+
+            def body2(x):
+                return lax.psum(x, "model")
+
+            good = jax.shard_map(body2, mesh=None,
+                                 in_specs=(P("model", None),),
+                                 out_specs=P())
+            """)
+        unsharded = [f for f in fs if f.rule == "mesh-unsharded-axis"]
+        assert len(unsharded) == 1
+        assert "'body'" not in unsharded[0].message  # message names axis
+        assert unsharded[0].line and "model" in unsharded[0].message
+
+    def test_incomplete_specs_skip_unsharded_rule(self, tmp_path):
+        """Specs holding a Name (espec, computed axis) make the
+        sharded-axes set unknowable -- no unsharded claim (moe.py)."""
+        fs = self._project(tmp_path, """
+            import jax
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            espec = P("data")
+
+            def body(x):
+                return lax.psum(x, "model")
+
+            f = jax.shard_map(body, mesh=None, in_specs=(espec,),
+                              out_specs=P())
+            """)
+        assert [f for f in fs if f.rule == "mesh-unsharded-axis"] == []
+
+    def test_nested_collective_fires_distinct_axes_do_not(
+            self, tmp_path):
+        fs = self._project(tmp_path, """
+            from jax import lax
+
+            def bad(x):
+                return lax.psum(lax.psum(x, "model"), "model")
+
+            def fine(x):
+                return lax.psum(lax.psum(x, "data"), "model")
+            """)
+        assert rules_of(fs) == ["mesh-nested-collective"]
+        assert len(fs) == 1
+
+    def test_multiline_shard_map_suppression_span(self, tmp_path):
+        """The core bugfix: ``# zoolint: disable=`` on ANY line of a
+        multi-line shard_map statement suppresses its finding (the
+        finding anchors to the in_specs line, the comment may sit on
+        the closing line)."""
+        fs = self._project(tmp_path, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def two_args(a, b):
+                return a + b
+
+            bad = jax.shard_map(
+                two_args,
+                mesh=None,
+                in_specs=(P("data"),),
+                out_specs=P(),
+            )  # zoolint: disable=mesh-spec-arity
+            """)
+        assert fs == []
+
+
+# ===================================================================== #
+# family 7: wire-protocol contracts                                     #
+# ===================================================================== #
+PROTOCOL_HOME = """
+URI_KEY = "__uri__"
+TRACE_KEY = "__trace__"
+WIRE_KEYS = (URI_KEY, TRACE_KEY)
+
+DEADLINE_PREFIX = "deadline_exceeded"
+CIRCUIT_PREFIX = "circuit_open"
+ERROR_PREFIXES = {DEADLINE_PREFIX: 504, CIRCUIT_PREFIX: 503}
+"""
+
+
+class TestProtocol:
+    CHECKER = [ProtocolChecker()]
+
+    REFS = ("\nfrom .protocol import DEADLINE_PREFIX, CIRCUIT_PREFIX\n"
+            "_USED = (DEADLINE_PREFIX, CIRCUIT_PREFIX)\n")
+
+    def _project(self, tmp_path, code, name="serving/front.py",
+                 home=PROTOCOL_HOME, refs=True):
+        """Write the declaring module + one user file; ``refs`` adds a
+        worker-side file referencing both prefixes so unrelated
+        unused-prefix warnings stay out of the assertion under test."""
+        (tmp_path / "serving").mkdir(parents=True, exist_ok=True)
+        (tmp_path / "serving" / "protocol.py").write_text(home)
+        if refs:
+            (tmp_path / "serving" / "uses.py").write_text(self.REFS)
+        path = tmp_path / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(code))
+        return run_zoolint([str(tmp_path)], checkers=self.CHECKER,
+                           repo_root=str(tmp_path))
+
+    def test_typod_wire_key_fires(self, tmp_path):
+        fs = self._project(tmp_path, """
+            def decode(z):
+                return z["__deadlin__"]
+            """)
+        assert rules_of(fs) == ["wire-key-literal"]
+        assert "__deadlin__" in fs[0].message
+
+    def test_hand_typed_copy_of_declared_key_fires(self, tmp_path):
+        fs = self._project(tmp_path, """
+            def decode(z):
+                return z["__trace__"]
+            """)
+        assert rules_of(fs) == ["wire-key-literal"]
+        assert "import the constant" in fs[0].message
+
+    def test_python_dunders_and_out_of_scope_do_not_fire(
+            self, tmp_path):
+        fs = self._project(tmp_path, """
+            if __name__ == "__main__":
+                print("__trace__ lives in serving only")
+            """, name="models/tool.py")
+        # models/ is outside the serving scope entirely
+        assert fs == []
+        fs = self._project(tmp_path, """
+            MODE = "__main__"
+            """)
+        assert fs == []
+
+    def test_inline_error_prefix_fires_constant_does_not(
+            self, tmp_path):
+        fs = self._project(tmp_path, """
+            from .protocol import DEADLINE_PREFIX, CIRCUIT_PREFIX
+
+            def reject(uri):
+                return "deadline_exceeded: request " + uri
+
+            def ok(uri):
+                return f"{DEADLINE_PREFIX}: request {uri}"
+
+            _USED = CIRCUIT_PREFIX
+            """, refs=False)
+        assert rules_of(fs) == ["error-prefix-literal"]
+        assert len(fs) == 1
+
+    def test_event_emission_is_not_a_prefix_copy(self, tmp_path):
+        """emit("deadline_exceeded", ...) is the EVENT vocabulary --
+        a different namespace, owned by the vocabulary family."""
+        fs = self._project(tmp_path, """
+            def on_expire(emit):
+                emit("deadline_exceeded", "serving", uri="u")
+            """)
+        assert fs == []
+
+    def test_frontend_unmapped_prefix_fires_via_indirection(
+            self, tmp_path):
+        """Satellite fixture: the frontend maps a prefix no worker
+        declares -- through one level of variable indirection, so the
+        dataflow layer (not a literal grep) must catch it."""
+        fs = self._project(tmp_path, """
+            _PREFIX = "deadline_exceded"
+
+            def to_http(msg):
+                if msg.startswith(_PREFIX):
+                    return 504
+                return 500
+            """)
+        assert "error-prefix-unknown" in rules_of(fs)
+        assert any("deadline_exceded" in f.message for f in fs)
+
+    def test_declared_prefix_startswith_does_not_fire(self, tmp_path):
+        fs = self._project(tmp_path, """
+            from .protocol import DEADLINE_PREFIX, CIRCUIT_PREFIX
+
+            def to_http(msg):
+                if msg.startswith(DEADLINE_PREFIX):
+                    return 504
+                if msg.startswith("tcp://"):
+                    return 0
+                return 500
+
+            _USED = CIRCUIT_PREFIX
+            """, refs=False)
+        assert fs == []
+
+    def test_scheme_sniffing_startswith_does_not_fire(self, tmp_path):
+        """Snake-case startswith literals that are NOT near a declared
+        prefix are ordinary string tests (backend scheme sniffing) --
+        the unknown-prefix rule targets typos, not every word."""
+        fs = self._project(tmp_path, """
+            def pick(backend):
+                if backend.startswith("redis"):
+                    return "redis"
+                if backend.startswith("unix"):
+                    return "unix"
+                return "memory"
+            """)
+        assert fs == []
+
+    def test_multiline_suppression_does_not_leak_across_match(
+            self, tmp_path):
+        """A disable comment inside one match case must not silence a
+        finding in a sibling case (Match is a compound statement)."""
+        fs = self._project(tmp_path, """
+            def decode(z, mode):
+                match mode:
+                    case "a":
+                        x = "fine"  # zoolint: disable=wire-key-literal
+                    case _:
+                        x = z["__deadlin__"]
+                return x
+            """)
+        assert rules_of(fs) == ["wire-key-literal"]
+
+    def test_prefix_missing_from_error_prefixes_fires(self, tmp_path):
+        fs = self._project(tmp_path, "X = 1\n", home="""
+URI_KEY = "__uri__"
+WIRE_KEYS = (URI_KEY,)
+DEADLINE_PREFIX = "deadline_exceeded"
+CIRCUIT_PREFIX = "circuit_open"
+OOM_PREFIX = "oom_killed"
+ERROR_PREFIXES = {DEADLINE_PREFIX: 504, CIRCUIT_PREFIX: 503}
+""" + "_OOM_USED_ELSEWHERE = None\n")
+        # OOM_PREFIX: no HTTP mapping AND never referenced outside
+        unmapped = [f for f in fs if f.rule == "error-prefix-unmapped"]
+        assert len(unmapped) == 2
+        assert all("OOM_PREFIX" in f.message for f in unmapped)
+
+    def test_second_vocab_module_fires(self, tmp_path):
+        fs = self._project(tmp_path, """
+            ROGUE_PREFIX = "shed_overload"
+            """)
+        assert "protocol-vocab-module" in rules_of(fs)
+
+
+# ===================================================================== #
+# config-type (family 3 extension)                                      #
+# ===================================================================== #
+CONFIG_TYPED_FIXTURE = """
+_DEFAULTS = {
+    "zoo.a.count": 4,
+    "zoo.a.rate": 0.5,
+    "zoo.a.mode": "auto",
+}
+_SPECS = {
+    "zoo.a.count": ("int", 1, 64),
+    "zoo.a.rate": ("float", 0, None),
+    "zoo.a.mode": ("enum", "auto", "fast"),
+}
+"""
+
+
+class TestConfigTypes:
+    CHECKER = [ConfigKeyChecker()]
+
+    def _project(self, tmp_path, user_code,
+                 fixture=CONFIG_TYPED_FIXTURE):
+        (tmp_path / "common").mkdir(parents=True, exist_ok=True)
+        (tmp_path / "common" / "config.py").write_text(fixture)
+        (tmp_path / "user.py").write_text(textwrap.dedent(user_code))
+        fs = run_zoolint([str(tmp_path)], checkers=self.CHECKER,
+                         repo_root=str(tmp_path))
+        return [f for f in fs if f.rule == "config-type"]
+
+    def test_contradicting_default_and_range_fire(self, tmp_path):
+        fs = self._project(tmp_path, """
+            def f(cfg):
+                a = cfg.get("zoo.a.count", "lots")
+                b = cfg.get("zoo.a.count", 128)
+                c = cfg.get("zoo.a.mode", "turbo")
+                return a, b, c
+            """)
+        msgs = [f.message for f in fs]
+        assert len(fs) == 3
+        assert any("'lots'" in m for m in msgs)
+        assert any("<= 64" in m for m in msgs)
+        assert any("'turbo'" in m for m in msgs)
+
+    def test_contradicting_cast_fires(self, tmp_path):
+        fs = self._project(tmp_path, """
+            def f(cfg):
+                return int(cfg.get("zoo.a.mode", "auto"))
+            """)
+        assert len(fs) == 1 and "int() cast" in fs[0].message
+
+    def test_compatible_sites_do_not_fire(self, tmp_path):
+        """int default for a float key, get(key, None) sentinel, and a
+        matching cast are all fine."""
+        fs = self._project(tmp_path, """
+            def f(cfg):
+                a = float(cfg.get("zoo.a.rate", 1))
+                b = cfg.get("zoo.a.count", None)
+                c = int(cfg.get("zoo.a.count", 8))
+                return a, b, c
+            """)
+        assert fs == []
+
+    def test_spec_defaults_self_check_fires(self, tmp_path):
+        fs = self._project(tmp_path, "X = 1\n", fixture="""
+_DEFAULTS = {
+    "zoo.a.count": 0,
+}
+_SPECS = {
+    "zoo.a.count": ("int", 1, 64),
+    "zoo.a.ghost": ("bool",),
+}
+""")
+        msgs = [f.message for f in fs]
+        assert len(fs) == 2
+        assert any("violates its own _SPECS" in m for m in msgs)
+        assert any("ghost" in m for m in msgs)
+
+    def test_runtime_validators_agree_with_specs(self):
+        """The shipped _DEFAULTS must satisfy the shipped _SPECS (the
+        lint self-check, exercised at runtime too)."""
+        from analytics_zoo_tpu.common import config as cfg_mod
+        for key, default in cfg_mod._DEFAULTS.items():
+            cfg_mod.validate_config_value(key, default)
+        with pytest.raises(ValueError):
+            cfg_mod.validate_config_value(
+                "zoo.serving.pipeline.depth", 0)
+        with pytest.raises(ValueError):
+            cfg_mod.validate_config_value(
+                "zoo.ops.attention_impl", "turbo")
+
+
+# ===================================================================== #
 # CLI contract                                                          #
 # ===================================================================== #
 VIOLATIONS = {
-    # one deliberate violation per ISSUE-4 checker family
+    # one deliberate violation per checker family (ISSUE-4 + the
+    # ISSUE-6 shardcheck families)
     "trace": ("pkg/step.py", """
         import jax
 
@@ -535,10 +1114,33 @@ VIOLATIONS = {
         """),
     "config": ("pkg/common/config.py", """
         _DEFAULTS = {"zoo.dead.key": 1}
+        _SPECS = {"zoo.dead.key": ("bool",)}
         """),
     "vocabulary": ("pkg/metrics_owner.py", """
         _REG = object()
         _M = _REG.counter("not_a_zoo_metric", "bad name")
+        """),
+    "mesh": ("pkg/par.py", """
+        import jax
+
+        def body(x):
+            return x
+
+        f = jax.shard_map(body, mesh=None, in_specs=(None, None),
+                          out_specs=None)
+        """),
+    "protocol": ("pkg/serving/fe.py", """
+        from pkg.serving.proto import DEADLINE_PREFIX
+
+        def decode(z, msg):
+            _USED = DEADLINE_PREFIX
+            return z["__deadlin__"]
+        """),
+    "protocol_home": ("pkg/serving/proto.py", """
+        URI_KEY = "__uri__"
+        WIRE_KEYS = (URI_KEY,)
+        DEADLINE_PREFIX = "deadline_exceeded"
+        ERROR_PREFIXES = {DEADLINE_PREFIX: 504}
         """),
 }
 
@@ -563,8 +1165,8 @@ class TestCLI:
     def test_nonzero_exit_and_all_families_reported(
             self, violation_tree):
         """One subprocess run covers the acceptance criterion for all
-        four families: deliberate violations -> exit 1, each family's
-        rule named in the output."""
+        families: deliberate violations -> exit 1, each family's rule
+        named in the output."""
         proc = _run_cli(["--no-baseline", "--json", "pkg"],
                         cwd=str(violation_tree))
         assert proc.returncode == 1, proc.stdout + proc.stderr
@@ -574,6 +1176,9 @@ class TestCLI:
         assert "thread-join" in fired                # family 2
         assert "config-unused" in fired              # family 3
         assert "metric-name" in fired                # family 4
+        assert "config-type" in fired                # ISSUE-6 family 3
+        assert "mesh-spec-arity" in fired            # ISSUE-6 family 1
+        assert "wire-key-literal" in fired           # ISSUE-6 family 2
 
     def test_baseline_workflow_grandfathers_findings(
             self, violation_tree):
@@ -619,6 +1224,97 @@ class TestCLI:
         assert proc.returncode == 1
         payload = json.loads(proc.stdout)
         assert {f["rule"] for f in payload["new"]} == {"thread-join"}
+
+
+class TestChangedMode:
+    """--changed lints only files changed vs a git ref. These tests
+    run the CLI against THIS repository (the CLI anchors --changed to
+    its own repo root), so they assert contracts that hold for any
+    working-tree state: a bogus ref falls back to a full run, and the
+    no-op fast path prints the 0-findings line without importing the
+    checker stack."""
+
+    def test_bad_ref_falls_back_to_full_run(self, tmp_path):
+        proc = _run_cli(["--changed", "no-such-ref-xyz",
+                         "--no-baseline"], cwd=str(tmp_path))
+        assert "falling back to a full run" in proc.stderr
+
+    def test_changed_refuses_update_baseline(self, tmp_path):
+        proc = _run_cli(["--changed", "--update-baseline"],
+                        cwd=str(tmp_path))
+        assert proc.returncode == 2
+        assert "full run" in proc.stderr
+
+    def test_changed_scopes_to_lint_paths(self, tmp_path):
+        """Changed files OUTSIDE the lint paths are not linted: point
+        the path filter at an empty dir -> the fast no-op path."""
+        empty = tmp_path / "nothing_here"
+        empty.mkdir()
+        proc = _run_cli(["--changed", "HEAD", str(empty)],
+                        cwd=str(tmp_path))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 finding(s), 0 new" in proc.stdout
+
+    def test_changed_json_fast_path_emits_json(self, tmp_path):
+        """--changed --json must produce the documented object shape
+        even on the nothing-changed fast path (jq consumers)."""
+        empty = tmp_path / "nothing_here"
+        empty.mkdir()
+        proc = _run_cli(["--changed", "HEAD", "--json", str(empty)],
+                        cwd=str(tmp_path))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload["counts"]["total"] == 0
+        assert payload["new"] == []
+
+    def test_changed_reports_only_changed_files(self, tmp_path,
+                                                monkeypatch):
+        """End-to-end in a scratch git repo: two files violate, one is
+        committed clean history, only the CHANGED one is reported."""
+        import shutil
+
+        repo = tmp_path / "repo"
+        pkg = repo / "pkg"
+        pkg.mkdir(parents=True)
+        clean = textwrap.dedent("""
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self.run)
+                    self._t.start()
+        """)
+        (pkg / "serving").mkdir()
+        (pkg / "serving" / "old.py").write_text(clean)
+        (pkg / "serving" / "new.py").write_text("X = 1\n")
+        # the CLI anchors its repo root two levels above itself, so
+        # install it as <repo>/scripts/zoolint.py in the scratch repo
+        (repo / "scripts").mkdir()
+        cli_copy = repo / "scripts" / "zoolint.py"
+        shutil.copy(CLI, cli_copy)
+
+        def git(*args):
+            return subprocess.run(
+                ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                 *args], cwd=str(repo), capture_output=True,
+                text=True, timeout=60)
+
+        assert git("init", "-q").returncode == 0
+        assert git("add", "-A").returncode == 0
+        assert git("commit", "-qm", "seed").returncode == 0
+        # old.py's violation is committed history; new.py gains one
+        (pkg / "serving" / "new.py").write_text(clean)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, str(cli_copy),
+             "--changed", "HEAD", "--no-baseline", "--json", "pkg"],
+            cwd=str(repo), env=env, capture_output=True, text=True,
+            timeout=180)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout)
+        paths = {f["path"] for f in payload["new"]}
+        assert paths == {"pkg/serving/new.py"}
 
 
 # ===================================================================== #
