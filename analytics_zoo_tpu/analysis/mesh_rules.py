@@ -66,6 +66,9 @@ _COLLECTIVES: Dict[str, int] = {
     # parallel.collectives wrappers (same contract, repo idiom)
     "all_reduce_sum": 1, "all_reduce_mean": 1, "reduce_scatter": 1,
     "ring_permute": 1, "global_norm": 1,
+    # EQuARX-idiom quantized collectives (serving shard layer): same
+    # axis-name contract, so typo'd axes fail lint before a mesh run
+    "quantized_psum": 1, "quantized_all_gather": 1,
 }
 _AXIS_KWARG = "axis_name"
 # DATA_AXIS / FSDP_AXIS / ... declaration-constant naming (suffix

@@ -104,6 +104,22 @@ _DEFAULTS: Dict[str, Any] = {
     # refusal into 503 + Retry-After instead of letting p99 explode
     "zoo.serving.shed.queue_depth": 0,
     "zoo.serving.shed.retry_after_s": 1.0,
+    # sharded serving (inference/sharded.py): route predict_async
+    # through a device mesh. mode: off (single-chip, byte-identical to
+    # the pre-mesh engine incl. compile-cache keys) | tp (params
+    # sharded by the recipe over zoo.mesh.axis.model, batch
+    # replicated) | dp (params replicated, batch sharded) | auto
+    # (tp when param bytes exceed auto_hbm_fraction of one chip's HBM,
+    # else dp). quantized_collectives opts the tp engine into the
+    # EQuARX-idiom int8 shard re-assembly (approximate; exact GSPMD is
+    # the default). devices: 0 = the whole backend, N = first N.
+    # auto_hbm_bytes: 0 = probe device memory_stats.
+    "zoo.serving.shard.mode": "off",
+    "zoo.serving.shard.recipe": "transformer_tp",
+    "zoo.serving.shard.quantized_collectives": False,
+    "zoo.serving.shard.devices": 0,
+    "zoo.serving.shard.auto_hbm_bytes": 0,
+    "zoo.serving.shard.auto_hbm_fraction": 0.6,
     # chaos harness (serving/chaos.py): seeded, deterministic fault
     # injection behind the same seams the Supervisor watches; spec
     # grammar "kind:seam[:k=v]*;..." (see docs/serving.md)
@@ -189,6 +205,13 @@ _SPECS: Dict[str, tuple] = {
     "zoo.serving.deadline_ms": ("float", 0, None),
     "zoo.serving.shed.queue_depth": ("int", 0, None),
     "zoo.serving.shed.retry_after_s": ("float", 0, None),
+    "zoo.serving.shard.mode": ("enum", "off", "tp", "dp", "auto"),
+    "zoo.serving.shard.recipe": ("enum", "transformer_tp",
+                                 "embedding_tp"),
+    "zoo.serving.shard.quantized_collectives": ("bool",),
+    "zoo.serving.shard.devices": ("int", 0, None),
+    "zoo.serving.shard.auto_hbm_bytes": ("int", 0, None),
+    "zoo.serving.shard.auto_hbm_fraction": ("float", 0, 1),
     "zoo.serving.chaos.enabled": ("bool",),
     "zoo.serving.chaos.seed": ("int", None, None),
     "zoo.serving.chaos.spec": ("str",),

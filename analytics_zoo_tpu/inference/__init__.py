@@ -11,6 +11,10 @@ serves all threads (SURVEY.md section 7 step 7).
 from analytics_zoo_tpu.inference.inference_model import (  # noqa: F401
     InferenceModel,
 )
+from analytics_zoo_tpu.inference.sharded import (  # noqa: F401
+    ShardPlan,
+    resolve_shard_plan,
+)
 from analytics_zoo_tpu.inference.quantize import (  # noqa: F401
     dequantize_params,
     quantize_params,

@@ -39,15 +39,23 @@ logger = get_logger(__name__)
 # device batch is bucket padding (wasted compute; high ratios mean the
 # batcher's caps sit badly against the bucket ladder)
 _REG = get_registry()
+# compile / pad-ratio series carry (bucket, shard mode) labels so a
+# sharded deployment's entries stay distinguishable from single-chip
+# ones in /metrics instead of aggregating into one series (mode "off"
+# = the unsharded engine; "tp"/"dp"/"tp_q8" = inference/sharded.py)
 _M_COMPILES = _REG.counter(
     "zoo_inference_compile_total",
-    "XLA shape-bucket compiles (flat after warm-up in a healthy "
-    "deployment; climbing means requests pay compile stalls)")
+    "XLA shape-bucket compiles by (bucket, shard mode) -- flat after "
+    "warm-up in a healthy deployment; climbing means requests pay "
+    "compile stalls", labelnames=("bucket", "mode"))
 _M_DISPATCH = _REG.counter(
-    "zoo_inference_dispatch_total", "Prediction batches dispatched")
+    "zoo_inference_dispatch_total",
+    "Prediction batches dispatched, by shard mode",
+    labelnames=("mode",))
 _M_PAD = _REG.histogram(
     "zoo_inference_batch_pad_ratio",
-    "Fraction of each dispatched device batch that is bucket padding",
+    "Fraction of each dispatched device batch that is bucket padding, "
+    "by (bucket, shard mode)", labelnames=("bucket", "mode"),
     buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
 
 
@@ -95,6 +103,9 @@ class InferenceModel:
         self._lock = threading.Lock()
         self._quantized = False
         self.example_input = None  # set by load_zoo for warm_up
+        # mesh routing (inference/sharded.py): None = single-chip, the
+        # pre-mesh engine byte-for-byte (including cache keys)
+        self.shard_plan = None
 
     # ------------------------------------------------------------ loads --
     def load_zoo(self, path: str) -> "InferenceModel":
@@ -238,6 +249,10 @@ class InferenceModel:
 
         if self.variables is None:
             raise RuntimeError("load a model before quantize()")
+        if self.shard_plan is not None:
+            raise RuntimeError("quantize() before shard(): weight-only "
+                               "quantization rebuilds the variable "
+                               "tree the plan committed to its mesh")
         q_tree, scales = quantize_params(self.variables, min_size)
         inner = self._apply_fn
 
@@ -249,6 +264,47 @@ class InferenceModel:
         self._compiled.clear()
         self._quantized = True
         return self
+
+    # ------------------------------------------------------------ shard --
+    def shard(self, plan="config") -> "InferenceModel":
+        """Route prediction through a device mesh
+        (:mod:`analytics_zoo_tpu.inference.sharded`). ``plan="config"``
+        resolves ``zoo.serving.shard.*``; pass a :class:`ShardPlan` to
+        pick the mesh explicitly, or None for a no-op. Attach AFTER
+        ``quantize()`` (weight-only int8 replaces the variable tree) and
+        before ``warm_up`` so the ladder compiles under the active
+        mesh. Attaching commits the variables onto the mesh; the bucket
+        cache keeps any pre-attach entries -- their keys cannot collide
+        with the plan-signed ones."""
+        if plan == "config":
+            from analytics_zoo_tpu.inference.sharded import (
+                resolve_shard_plan)
+
+            plan = resolve_shard_plan(self.variables)
+        if plan is None:
+            return self
+        if self.variables is None:
+            raise RuntimeError("load a model before shard()")
+        if self.shard_plan is not None:
+            raise RuntimeError(
+                "a shard plan is already attached; build a fresh "
+                "InferenceModel to re-shard (variables are committed "
+                "to the previous mesh)")
+        self.variables = plan.place_variables(self.variables)
+        self.shard_plan = plan
+        return self
+
+    def _bucket_for(self, n: int) -> int:
+        """The device-batch bucket covering ``n``: the power-of-two
+        ladder single-chip; under a batch-splitting shard plan the same
+        ladder in units of the mesh size (every bucket divides evenly
+        across the devices -- and re-bucketing a bucket is a fixed
+        point, so warmed sizes stay warmed)."""
+        plan = self.shard_plan
+        m = plan.batch_multiple if plan is not None else 1
+        if m <= 1:
+            return _bucket(n)
+        return m * _bucket(-(-n // m))
 
     # ---------------------------------------------------------- warm-up --
     def warm_up(self, example_input,
@@ -275,7 +331,7 @@ class InferenceModel:
         # GraphFunction signatures)
         with warming():
             for bs in batch_sizes:
-                bucket = _bucket(bs)
+                bucket = self._bucket_for(bs)
                 if bucket in done:
                     continue
                 done.add(bucket)
@@ -321,7 +377,8 @@ class InferenceModel:
         x = jax.tree_util.tree_map(canon, x)
         leaves = jax.tree_util.tree_leaves(x)
         n = leaves[0].shape[0]
-        bucket = _bucket(n)
+        plan = self.shard_plan
+        bucket = self._bucket_for(n)
 
         def pad(a):
             if a.shape[0] == bucket:
@@ -331,17 +388,29 @@ class InferenceModel:
             return reps
 
         padded = jax.tree_util.tree_map(pad, x)
+        # sharding-aware cache key: the plain shape tuple single-chip
+        # (EXACTLY the pre-mesh key, so warm persistent caches survive
+        # the upgrade) and (shapes, plan signature) under a mesh --
+        # single-chip and sharded entries, or two different meshes,
+        # can never collide
         key = self._shape_key(padded)
+        mode = "off"
+        if plan is not None:
+            key = (key, plan.signature)
+            mode = plan.label
+            padded = plan.place_batch(padded)
         with self._lock:
             fn = self._compiled.get(key)
             fresh = fn is None
             if fresh:
-                fn = jax.jit(self._apply_fn)
+                fn = (plan.build_fn(self._apply_fn) if plan is not None
+                      else jax.jit(self._apply_fn))
                 self._compiled[key] = fn
-                _M_COMPILES.inc()
+                _M_COMPILES.labels(bucket=str(bucket), mode=mode).inc()
                 logger.info("inference: compiling bucket %s", key)
-        _M_DISPATCH.inc()
-        _M_PAD.observe((bucket - n) / bucket)
+        _M_DISPATCH.labels(mode=mode).inc()
+        _M_PAD.labels(bucket=str(bucket),
+                      mode=mode).observe((bucket - n) / bucket)
         if fresh:
             # first dispatch of a new bucket: jax traces + XLA-compiles
             # synchronously inside this call, so its wall time ~= the

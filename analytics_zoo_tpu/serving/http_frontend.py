@@ -476,6 +476,14 @@ class HttpFrontend:
             },
             "inflight_requests": get_inflight().snapshot(),
         }
+        # protocol-visible shard info: what you diff when one
+        # deployment serves sharded and another doesn't (mode "off" is
+        # the explicit single-chip answer, not an absent block)
+        shard_plan = getattr(getattr(self.worker, "model", None),
+                             "shard_plan", None)
+        out["serving_shard"] = (shard_plan.describe()
+                                if shard_plan is not None
+                                else {"mode": "off"})
         try:
             import jax
 

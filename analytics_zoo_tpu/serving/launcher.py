@@ -146,6 +146,36 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     params = config.get("params") or {}
     http = config.get("http") or {}
 
+    # mesh routing (inference/sharded.py): an optional `shard:` YAML
+    # block rides into plan resolution as PER-LAUNCH overrides of the
+    # zoo.serving.shard.* keys -- never written into the process-global
+    # config, so a later launch() in this process cannot inherit this
+    # deployment's sharding. The resolved plan attaches BEFORE warm-up
+    # so the bucket ladder compiles under the active mesh.
+    shard_cfg = config.get("shard") or {}
+    _shard_yaml_keys = {
+        "mode": "zoo.serving.shard.mode",
+        "recipe": "zoo.serving.shard.recipe",
+        "quantized_collectives":
+            "zoo.serving.shard.quantized_collectives",
+        "devices": "zoo.serving.shard.devices",
+    }
+    from analytics_zoo_tpu.common.config import validate_config_value
+
+    # set() is deliberately permissive and validate_config() already
+    # ran above -- values arriving through the shard block must pass
+    # the same launch-time spec check or the fail-fast guarantee has a
+    # YAML-shaped hole
+    shard_overrides = {
+        cfg_key: validate_config_value(cfg_key, shard_cfg[yaml_key])
+        for yaml_key, cfg_key in _shard_yaml_keys.items()
+        if yaml_key in shard_cfg}
+    from analytics_zoo_tpu.inference.sharded import (
+        maybe_shard_from_config)
+
+    shard_plan = maybe_shard_from_config(model,
+                                         overrides=shard_overrides)
+
     if data.get("queue") == "dir" and not data.get("path"):
         raise ValueError('data.queue "dir" needs data.path')
     queue_kind = data.get("queue")
@@ -273,6 +303,8 @@ def launch(config: Dict[str, Any]) -> ServingApp:
         queue=str(data.get("queue") or "memory"),
         pipelined=worker.pipelined,
         http=bool(http.get("enabled", True)),
+        shard_mode=(shard_plan.label if shard_plan is not None
+                    else "off"),
         address=frontend.address if frontend is not None else None)
     return ServingApp(model, worker, in_q, out_q, frontend,
                       redis_frontend=redis_fe, reporter=reporter,

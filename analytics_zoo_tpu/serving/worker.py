@@ -996,6 +996,9 @@ class ServingWorker:
             pass
         out = {"served": self.served, "stages": self.timer.summary(),
                "pipeline": pipe}
+        shard_plan = getattr(self.model, "shard_plan", None)
+        if shard_plan is not None:
+            out["shard"] = shard_plan.describe()
         if self.breaker is not None:
             out["breaker"] = self.breaker.stats()
         if self.ledger is not None:

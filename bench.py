@@ -256,9 +256,8 @@ def measure_serving(seconds: float, batch: int):
         # windows indicate requests paying live XLA stalls
         from analytics_zoo_tpu.obs.metrics import get_registry as _gr
 
-        _compile_fam = _gr().get("zoo_inference_compile_total")
-        compiles_at_launch = (_compile_fam.value
-                              if _compile_fam is not None else 0)
+        compiles_at_launch = _fam_total(
+            _gr().get("zoo_inference_compile_total"))
         try:
             # the host->device tunnel is the client-observed ceiling on
             # this rig AND swings ~5x by the minute -- probe it before
@@ -397,8 +396,9 @@ def measure_serving(seconds: float, batch: int):
                 if fam is None:
                     return 0
                 try:
-                    return (fam.snapshot(False).get(field, 0)
-                            if fam.kind == "histogram" else fam.value)
+                    if fam.kind == "histogram":
+                        return fam.snapshot(False).get(field, 0)
+                    return _fam_total(fam)
                 except Exception:
                     return 0
 
@@ -541,6 +541,15 @@ def measure_scaling_virtual(n: int = 8, timeout: float = 900.0):
         if line.startswith("{"):
             return json.loads(line)["value"]
     raise RuntimeError(f"scaling harness failed: {out.stderr[-500:]}")
+
+
+def _fam_total(fam) -> float:
+    """Sum over every series of a (possibly labelled) counter family --
+    the inference compile/dispatch counters carry (bucket, shard mode)
+    labels, and the bench wants the process total."""
+    if fam is None:
+        return 0
+    return sum(child.value for _, child in fam._items())
 
 
 def _init_backend(retries: int = 3):

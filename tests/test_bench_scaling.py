@@ -1,4 +1,6 @@
-"""Smoke the scaling-efficiency harness (north-star #3 tooling)."""
+"""Smoke the multichip harness: scaling efficiency (north-star #3),
+the crash-proof final-JSON contract (the r5 zeroed run's fix), and the
+sharded-serving A/B on the CPU host-device mesh (ISSUE-7)."""
 
 import json
 import os
@@ -8,16 +10,59 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_scaling_harness_outputs_json():
+def _clean_env(**extra):
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env.update(extra)
+    return env
+
+
+def test_scaling_harness_outputs_json():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench_scaling.py"),
          "--virtual", "4", "--per-device-batch", "256"],
-        capture_output=True, text=True, timeout=540, cwd=REPO, env=env)
+        capture_output=True, text=True, timeout=540, cwd=REPO,
+        env=_clean_env())
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = proc.stdout.strip().splitlines()[-1]
     out = json.loads(line)
     assert out["metric"] == "scaling_efficiency"
     assert set(out["extras"]["efficiency"]) == {"1", "2", "4"}
     assert out["extras"]["efficiency"]["1"] == 1.0
+
+
+def test_backend_unavailable_still_emits_final_json_line():
+    """The TPU-backend UNAVAILABLE failure that zeroed r5's run: a
+    bounded backend-init retry, then a guaranteed parseable final
+    line (bench.py's established convention)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_scaling.py")],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=_clean_env(JAX_PLATFORMS="bogus",
+                       BENCH_RETRY_DELAY_S="0.05"))
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, f"no stdout at all; stderr: {proc.stderr[-500:]}"
+    assert json.loads(lines[-1]) == {"value": None,
+                                     "error": "backend_unavailable"}
+    assert proc.stderr.count("backend init attempt") == 3
+
+
+def test_serving_shard_smoke_on_host_device_mesh():
+    """The multichip SERVING measurement runs hardware-free: 8 virtual
+    CPU devices, shard modes off + tp through the real pipelined
+    engine, one JSON line with the (size x mode) table."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_scaling.py"),
+         "--serving", "--virtual", "8", "--sizes", "small",
+         "--modes", "off,tp", "--serving-requests", "300",
+         "--windows", "1", "--matched-seconds", "1"],
+        capture_output=True, text=True, timeout=540, cwd=REPO,
+        env=_clean_env())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "serving_shard_ab"
+    table = out["extras"]["table"]["small"]
+    assert set(table) == {"off", "tp"}
+    for mode in table.values():
+        assert mode["rps"] > 0
+    assert out["extras"]["n_devices"] == 8

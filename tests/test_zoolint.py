@@ -715,6 +715,52 @@ class TestMeshRules:
             """)
         assert fs == []
 
+    def test_quantized_collective_typo_axis_fires(self, tmp_path):
+        """ISSUE-7 TP fixture: the EQuARX-idiom quantized collectives
+        carry the same axis-name contract as lax collectives -- a
+        typo'd axis reaching one must fail lint."""
+        fs = self._project(tmp_path, """
+            from analytics_zoo_tpu.parallel.collectives import (
+                quantized_psum)
+
+            def body(x):
+                return quantized_psum(x, "modle")
+            """)
+        assert rules_of(fs) == ["mesh-axis-unbound"]
+        assert "modle" in fs[0].message
+
+    def test_quantized_collective_declared_or_param_axis_clean(
+            self, tmp_path):
+        """ISSUE-7 FP fixture: config_axis roles and pass-through
+        parameters (the sharded serving layer's own idioms) stay
+        clean."""
+        fs = self._project(tmp_path, """
+            from analytics_zoo_tpu.parallel.collectives import (
+                quantized_all_gather, quantized_psum)
+
+            def reassemble(leaf, axis_name):
+                return quantized_all_gather(leaf, axis_name, axis=0)
+
+            def body(x):
+                axis = config_axis("model")
+                return quantized_psum(x, axis)
+            """)
+        assert fs == []
+
+    def test_quantized_psum_over_unsharded_axis_warns(self, tmp_path):
+        """A quantized psum over an axis the enclosing shard_map never
+        shards is the same replicated-operand bug as the exact one."""
+        fs = self._project(tmp_path, """
+            import jax
+
+            def body(x):
+                return quantized_psum(x, "model")
+
+            f = jax.shard_map(body, mesh=None, in_specs=(P("data"),),
+                              out_specs=P("data"))
+            """)
+        assert rules_of(fs) == ["mesh-unsharded-axis"]
+
     def test_spec_arity_mismatch_fires_match_does_not(self, tmp_path):
         fs = self._project(tmp_path, """
             import jax
