@@ -34,6 +34,17 @@ review time:
                      findings
 - ``dataflow``       the shared reaching-definitions +
                      constant-propagation layer the above build on
+- ``callgraph``      deepcheck's project-wide call graph: resolved
+                     self.method / module-fn / intra-package-import /
+                     one-alias-level edges, with jit / collective /
+                     serving-hot-path context propagation and
+                     per-parameter tracer/device taint
+- ``deep_rules``     the interprocedural families on top of it:
+                     transitive trace hazards (jit-numpy-call &c. one
+                     call deep, jit-host-callback-undeclared),
+                     hot-path host syncs (hotpath-block-on-device),
+                     and dtype drift (dtype-upcast-f32,
+                     dtype-mixed-collective)
 
 Entry points: ``scripts/zoolint.py`` (CLI, baseline-aware, ``--json``)
 and ``tests/test_zoolint.py`` (tier-1 gate). Findings suppress inline

@@ -204,8 +204,8 @@ def register(cls: type) -> type:
 def _load_builtin_checkers() -> None:
     # import for side effect: each module @register-s its checkers
     from analytics_zoo_tpu.analysis import (  # noqa: F401
-        concurrency, config_keys, hygiene, mesh_rules, protocol,
-        trace_hazards, vocabulary)
+        concurrency, config_keys, deep_rules, hygiene, mesh_rules,
+        protocol, trace_hazards, vocabulary)
 
 
 def all_checkers() -> List[Checker]:

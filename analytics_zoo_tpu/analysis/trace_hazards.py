@@ -171,6 +171,15 @@ class _JittedFn:
         self.params = names - _static_params(call, fn)
 
 
+def jitted_functions(src: SourceFile) -> List["_JittedFn"]:
+    """Every function the PR-4 detection counts as jit/pmap/shard_map
+    traced in this file (decorated, wrapped by name, or an inline
+    lambda). Shared with the interprocedural layer
+    (:mod:`analytics_zoo_tpu.analysis.callgraph`), which uses these as
+    the jit roots of its context propagation."""
+    return TraceHazardChecker()._jitted_functions(src)
+
+
 @register
 class TraceHazardChecker(Checker):
     name = "trace"
@@ -344,6 +353,16 @@ class TraceHazardChecker(Checker):
 
     # --------------------------------------------------------- driver --
     def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        # memoized on the SourceFile: the deep layer re-runs this scan
+        # over every file just to dedup its transitive findings, and
+        # one parse's findings never change within a run
+        cached = getattr(src, "_trace_findings", None)
+        if cached is None:
+            cached = list(self._check_uncached(src))
+            src._trace_findings = cached
+        return cached
+
+    def _check_uncached(self, src: SourceFile) -> Iterable[Finding]:
         for jf in self._jitted_functions(src):
             yield from self._check_body(src, jf)
         yield from self._check_static_argnums(src)

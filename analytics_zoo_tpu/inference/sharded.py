@@ -83,26 +83,13 @@ _FALLBACK_HBM_BYTES = 16 << 30
 
 
 def _shard_map(f, mesh: Mesh, in_specs, out_specs):
-    """Version-compat shard_map: jax >= 0.5 exposes ``jax.shard_map``
-    (``check_vma``), 0.4.x ships ``jax.experimental.shard_map``
-    (``check_rep``). Replication checking is off either way -- the
-    quantized body's per-shard scales are intentionally divergent."""
-    sm = getattr(jax, "shard_map", None)
-    if sm is not None:
-        try:
-            return sm(f, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_vma=False)
-        except TypeError:
-            return sm(f, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs)
-    from jax.experimental.shard_map import shard_map as esm
+    """Version-compat shard_map (kept as the module's historical name;
+    the one implementation lives in ``parallel.mesh.shard_map`` and is
+    shared with ``parallel/`` so the whole tree runs on both jax
+    lines)."""
+    from analytics_zoo_tpu.parallel.mesh import shard_map
 
-    try:
-        return esm(f, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs, check_rep=False)
-    except TypeError:
-        return esm(f, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs)
+    return shard_map(f, mesh, in_specs, out_specs)
 
 
 def _spec_fn_for(recipe: str, axis: str) -> Callable:

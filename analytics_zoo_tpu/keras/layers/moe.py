@@ -187,12 +187,14 @@ class MoEFFN(nn.Module):
                 # the psum over the expert axis completes the routed sum
                 return jax.lax.psum(out, axis)
 
+            from analytics_zoo_tpu.parallel.mesh import shard_map
+
             espec = P(axis)
-            out = jax.shard_map(
-                local, mesh=mesh,
+            out = shard_map(
+                local, mesh,
                 in_specs=(P(data, None, None), espec, espec, espec,
                           espec, P(data, None, axis)),
-                out_specs=P(data, None, None), check_vma=False)(
+                out_specs=P(data, None, None))(
                 xc, wi, bi, wo, bo, gc)
         else:
             out = experts_contrib(xc, wi, bi, wo, bo, gc)
@@ -268,14 +270,16 @@ class MoEFFN(nn.Module):
             out = jnp.einsum("nec,ech->nh", combine, y)
             return out.reshape(b, L, h)
 
+        from analytics_zoo_tpu.parallel.mesh import shard_map
+
         tspec = P((data, axis) if data else axis, None, None)
         espec = P(axis)
-        return jax.shard_map(
-            local, mesh=mesh,
+        return shard_map(
+            local, mesh,
             in_specs=(tspec, espec, espec, espec, espec,
                       P((data, axis) if data else axis, None, None),
                       P((data, axis) if data else axis, None, None)),
-            out_specs=tspec, check_vma=False)(
+            out_specs=tspec)(
             xc, wi, bi, wo, bo, top_idx, top_p)
 
 

@@ -21,6 +21,7 @@ from analytics_zoo_tpu.parallel.mesh import (  # noqa: F401
     create_mesh,
     default_mesh,
     mesh_axis_size,
+    shard_map,
 )
 from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
     named_sharding,

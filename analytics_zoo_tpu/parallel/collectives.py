@@ -37,7 +37,7 @@ def reduce_scatter(x: Any, axis_name: str, axis: int = 0) -> Any:
 
 def ring_permute(x: Any, axis_name: str, shift: int = 1) -> Any:
     """Send to the next device on the ring (rank -> rank+shift mod N)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.tree_util.tree_map(
         lambda t: lax.ppermute(t, axis_name, perm), x)
@@ -48,7 +48,13 @@ def axis_index(axis_name: str):
 
 
 def axis_size(axis_name: str) -> int:
-    return lax.axis_size(axis_name)
+    """Static size of a named mesh axis from inside a mapped body.
+    jax 0.4.x has no ``lax.axis_size``; ``psum(1, axis)`` is the
+    classic spelling and constant-folds to a python int either way."""
+    size = getattr(lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return lax.psum(1, axis_name)
 
 
 def _q8(t: jnp.ndarray):

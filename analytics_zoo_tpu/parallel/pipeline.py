@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from analytics_zoo_tpu.parallel.mesh import config_axis
+from analytics_zoo_tpu.parallel.collectives import axis_size
+from analytics_zoo_tpu.parallel.mesh import config_axis, shard_map
 
 
 def _pipeline_local(stage_params, microbatches, rng, stage_fn,
@@ -36,7 +37,7 @@ def _pipeline_local(stage_params, microbatches, rng, stage_fn,
       makes dropout exact-reproducible between the pipeline schedule
       and a sequential run of the same blocks.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage_id = lax.axis_index(axis_name)
     # shard_map keeps the sharded leading stage dim as size 1; strip it
     stage_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
@@ -117,11 +118,10 @@ def pipeline_apply(stage_fn: Callable[..., jnp.ndarray],
     body = partial(_pipeline_local, stage_fn=stage_fn,
                    axis_name=axis_name, n_microbatches=n_microbatches,
                    **({"rng": None} if rng is None else {}))
-    fn = jax.shard_map(
-        body, mesh=mesh,
+    fn = shard_map(
+        body, mesh,
         in_specs=(param_specs, mb_spec) + (P(),) * len(extra),
-        out_specs=mb_spec,
-        check_vma=False)
+        out_specs=mb_spec)
     return fn(stacked_params, microbatches, *extra)
 
 

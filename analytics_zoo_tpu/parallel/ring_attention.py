@@ -30,7 +30,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from analytics_zoo_tpu.parallel.mesh import config_axis
+from analytics_zoo_tpu.parallel.collectives import axis_size
+from analytics_zoo_tpu.parallel.mesh import config_axis, shard_map
 
 NEG_INF = -1e30
 
@@ -80,7 +81,7 @@ def _ring_attn_local(q, k, v, rng, axis_name: str, causal: bool,
                      scale: Optional[float], dropout_rate: float = 0.0,
                      batch_axis=None):  # str | tuple[str, ...] | None
     """Per-device body, runs under shard_map with seq-sharded q/k/v."""
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     if rng is not None and batch_axis is not None:
         # each batch shard draws its own masks: without this fold the
@@ -147,14 +148,14 @@ def _ring_shard_call(local_fn, q, k, v, mesh, axis_name, qkv_spec,
     elif not isinstance(batch_axis, str):
         batch_axis = None
     extra = (dropout_rng,) if dropping else ()
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(local_fn, axis_name=axis_name,
                 dropout_rate=dropout_rate if dropping else 0.0,
                 batch_axis=batch_axis if dropping else None,
                 **({} if dropping else {"rng": None}), **fn_kwargs),
-        mesh=mesh,
+        mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec) + (P(),) * len(extra),
-        out_specs=qkv_spec, check_vma=False)
+        out_specs=qkv_spec)
     return fn(q, k, v, *extra)
 
 
@@ -253,7 +254,7 @@ def _zigzag_local(q, k, v, rng, axis_name: str, scale: Optional[float],
     (q_early x kv_late is never needed: every late chunk sits after
     every early chunk.) A and C toggle via per-core ``lax.cond``, so
     masked tiles cost a branch, not a matmul."""
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     if rng is not None and batch_axis is not None:
         rng = jax.random.fold_in(rng, lax.axis_index(batch_axis))

@@ -311,10 +311,12 @@ class TCMFForecaster:
                 k: (P("data", None) if k == "F"
                     else jax.tree_util.tree_map(lambda _: P(), v))
                 for k, v in params.items()}
-            body = jax.shard_map(
-                partial(loss_fn, psum_axis="data"), mesh=mesh,
+            from analytics_zoo_tpu.parallel.mesh import shard_map
+
+            body = shard_map(
+                partial(loss_fn, psum_axis="data"), mesh,
                 in_specs=(param_specs, P("data", None)),
-                out_specs=(P(), (P(), P())), check_vma=False)
+                out_specs=(P(), (P(), P())))
 
             def full_loss(p):
                 return body(p, yn)
@@ -399,14 +401,16 @@ class TCMFForecaster:
 
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from analytics_zoo_tpu.parallel.mesh import shard_map
+
             mesh = series_sharding.mesh
             lp = jax.device_put(lp, NamedSharding(mesh, P()))
-            body = jax.shard_map(
-                partial(loss_fn, psum_axis="data"), mesh=mesh,
+            body = shard_map(
+                partial(loss_fn, psum_axis="data"), mesh,
                 in_specs=(jax.tree_util.tree_map(lambda _: P(), lp),
                           P("data", None, None, None),
                           P("data", None)),
-                out_specs=P(), check_vma=False)
+                out_specs=P())
 
             def full_loss(p):
                 return body(p, wins, targets)

@@ -75,6 +75,11 @@ def main(argv=None) -> int:
                     help="comma-separated rule subset to run")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--graph", action="store_true",
+                    help="dump deepcheck's resolved call graph as "
+                         "JSON (contexts, taint, edges, unresolved "
+                         "counts) instead of linting -- the debugging "
+                         "surface for 'why did/didn't this propagate'")
     ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
                     metavar="REF",
                     help="lint only .py files changed vs a git ref "
@@ -117,6 +122,18 @@ def main(argv=None) -> int:
     from analytics_zoo_tpu.analysis import all_rules, run_zoolint
     from analytics_zoo_tpu.analysis.baseline import (
         load_baseline, new_findings, stale_entries, write_baseline)
+
+    if args.graph:
+        from analytics_zoo_tpu.analysis.callgraph import \
+            build_call_graph
+        from analytics_zoo_tpu.analysis.core import (
+            Project, collect_files)
+
+        paths = args.paths or [os.path.join(REPO, "analytics_zoo_tpu")]
+        files, repo_root = collect_files(paths)
+        graph = build_call_graph(Project(files, repo_root=repo_root))
+        print(json.dumps(graph.to_dict(), indent=2, sort_keys=True))
+        return 0
 
     if args.list_rules:
         for rule, desc in sorted(all_rules().items()):

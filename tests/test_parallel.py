@@ -20,6 +20,7 @@ from analytics_zoo_tpu.parallel import (
     replicated,
     ring_attention,
     shard_batch,
+    shard_map,
 )
 
 
@@ -64,9 +65,11 @@ class TestCollectives:
     def test_allreduce_matches_sum(self):
         mesh = create_mesh()
         x = jnp.arange(8.0)
-        f = jax.shard_map(
+        # parallel's shard_map: the version-compat wrapper (jax 0.4.x
+        # has no jax.shard_map; the driver's jax does)
+        f = shard_map(
             lambda t: collectives.all_reduce_sum(t, "data"),
-            mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+            mesh, in_specs=P("data"), out_specs=P("data"))
         np.testing.assert_allclose(np.asarray(f(x)), np.full(8, 28.0))
 
     def test_global_norm(self):

@@ -1396,3 +1396,795 @@ class TestPackageGate:
                    if not e.get("rationale", "").strip()]
         assert not missing, (
             f"baseline entries missing a rationale: {missing}")
+
+
+# ===================================================================== #
+# deepcheck (ISSUE-8): call graph + interprocedural families            #
+# ===================================================================== #
+def _graph_of(tmp_path, files):
+    """Write {rel: code} and build the call graph over the tree."""
+    from analytics_zoo_tpu.analysis.callgraph import build_call_graph
+    from analytics_zoo_tpu.analysis.core import Project, collect_files
+
+    for rel, code in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(code))
+    parsed, root = collect_files([str(tmp_path)],
+                                 repo_root=str(tmp_path))
+    return build_call_graph(Project(parsed, repo_root=root))
+
+
+def _node(graph, suffix):
+    hits = [n for n in graph.nodes if n.qname.endswith(suffix)]
+    assert len(hits) == 1, f"{suffix}: {[n.qname for n in hits]}"
+    return hits[0]
+
+
+class TestCallGraph:
+    def test_cross_module_import_edge_and_context(self, tmp_path):
+        """A helper imported from another module inherits jit context
+        and per-parameter tracer taint through the edge."""
+        g = _graph_of(tmp_path, {
+            "main.py": """
+                import jax
+                from pkg.helpers import helper
+
+                @jax.jit
+                def step(x):
+                    return helper(x * 2)
+                """,
+            "pkg/helpers.py": """
+                def helper(z):
+                    return z + 1
+                """,
+        })
+        helper = _node(g, "pkg/helpers.py::helper")
+        assert "jit" in helper.contexts
+        assert helper.tracer_params == {"z"}
+        assert not helper.jit_direct
+
+    def test_module_alias_import_resolves(self, tmp_path):
+        g = _graph_of(tmp_path, {
+            "main.py": """
+                import jax
+                from pkg import helpers
+
+                @jax.jit
+                def step(x):
+                    return helpers.helper(x)
+                """,
+            "pkg/helpers.py": """
+                def helper(z):
+                    return z
+                """,
+        })
+        assert "jit" in _node(g, "pkg/helpers.py::helper").contexts
+
+    def test_self_method_resolution_including_nested_step(self, tmp_path):
+        """The repo's jitted-step idiom: a def nested inside a method
+        calls ``self._math`` -- the nested def's owning class resolves
+        through the enclosing chain."""
+        g = _graph_of(tmp_path, {
+            "est.py": """
+                import jax
+
+                class Est:
+                    def _math(self, v, x):
+                        return v + x
+
+                    def build(self):
+                        def step(v, x):
+                            return self._math(v, x)
+                        return jax.jit(step)
+                """,
+        })
+        math = _node(g, "est.py::Est._math")
+        assert "jit" in math.contexts
+        assert math.tracer_params == {"v", "x"}
+
+    def test_alias_indirection_one_level(self, tmp_path):
+        """``self._step = jax.jit(step)`` then ``self._step(...)``
+        resolves through the self-attribute alias + jit unwrap."""
+        g = _graph_of(tmp_path, {
+            "w.py": """
+                import jax
+
+                def step(x):
+                    return x
+
+                class Runner:
+                    def __init__(self):
+                        self._step = jax.jit(step)
+
+                    def run(self, batch):
+                        return self._step(batch)
+                """,
+        })
+        runner = _node(g, "w.py::Runner.run")
+        assert [e.callee.name for e in runner.edges_out] == ["step"]
+
+    def test_unresolvable_calls_are_conservative(self, tmp_path):
+        """Dict dispatch / attribute calls on unknown objects make NO
+        edges (and no contexts leak), they are only counted."""
+        g = _graph_of(tmp_path, {
+            "d.py": """
+                import jax
+
+                def helper(z):
+                    return z
+
+                HANDLERS = {"h": helper}
+
+                @jax.jit
+                def step(x, obj):
+                    HANDLERS["h"](x)
+                    obj.method(x)
+                    return x
+                """,
+        })
+        helper = _node(g, "d.py::helper")
+        assert helper.contexts == set()
+        assert sum(g.unresolved.values()) >= 2
+
+    def test_hot_path_roots_and_finalize_barrier(self, tmp_path):
+        g = _graph_of(tmp_path, {
+            "w.py": """
+                class ServingWorker:
+                    def _dispatch_group(self, group):
+                        shared(group)
+                        self._finalize_record(group)
+
+                    def _finalize_record(self, rec):
+                        sink(rec)
+
+                def shared(g):
+                    return g
+
+                def sink(r):
+                    return r
+                """,
+        })
+        assert "hotpath" in _node(g, "w.py::shared").contexts
+        seam = _node(g, "w.py::ServingWorker._finalize_record")
+        assert "hotpath" not in seam.contexts
+        assert "hotpath" not in _node(g, "w.py::sink").contexts
+
+    def test_declared_hot_path_roots(self, tmp_path):
+        g = _graph_of(tmp_path, {
+            "svc.py": """
+                ZOOLINT_HOT_PATH = ("serve_one", "Engine.tick")
+
+                def serve_one(req):
+                    return req
+
+                class Engine:
+                    def tick(self):
+                        return 1
+                """,
+        })
+        assert "hotpath" in _node(g, "svc.py::serve_one").contexts
+        assert "hotpath" in _node(g, "svc.py::Engine.tick").contexts
+
+    def test_graph_dump_shape(self, tmp_path):
+        g = _graph_of(tmp_path, {
+            "m.py": """
+                import jax
+
+                def helper(z):
+                    return z
+
+                @jax.jit
+                def step(x):
+                    return helper(x)
+                """,
+        })
+        d = g.to_dict()
+        assert d["counts"]["functions"] == 2
+        assert d["counts"]["edges"] == 1
+        helper = [f for f in d["functions"]
+                  if f["qname"].endswith("::helper")][0]
+        assert helper["contexts"] == ["jit"]
+        assert helper["tracer_params"] == ["z"]
+
+    def test_partial_wrapped_body_marked_collective(self, tmp_path):
+        """The pipeline idiom: a plain module function traced through
+        ``shard_map(partial(body, ...), ...)`` via an alias -- the
+        resolution gap that hid the real lax.axis_size crashes. The
+        partial's kw-bound params must NOT carry tracer taint."""
+        g = _graph_of(tmp_path, {
+            "pipe.py": """
+                import jax
+                from functools import partial
+
+                def _local(params, batch, stage_fn, axis_name):
+                    return stage_fn(params, batch)
+
+                def apply(params, batch, mesh, sf):
+                    body = partial(_local, stage_fn=sf,
+                                   axis_name="stage")
+                    fn = jax.shard_map(body, mesh=mesh,
+                                       in_specs=None, out_specs=None)
+                    return fn(params, batch)
+                """,
+        })
+        local = _node(g, "pipe.py::_local")
+        assert {"jit", "collective"} <= local.contexts
+        assert local.tracer_params == {"params", "batch"}
+        assert not local.jit_direct  # PR 4 cannot see this form
+
+    def test_param_wrapped_body_resolves_at_call_site(self, tmp_path):
+        """One higher-order level: ``_shard_call`` wraps its own
+        PARAMETER; the wrapped function is whatever its resolved call
+        sites pass (the ring-attention idiom)."""
+        g = _graph_of(tmp_path, {
+            "ring.py": """
+                import jax
+                from functools import partial
+
+                def _attn_local(q, k, v, axis_name):
+                    return q
+
+                def _shard_call(local_fn, q, k, v, mesh):
+                    fn = jax.shard_map(
+                        partial(local_fn, axis_name="seq"),
+                        mesh=mesh, in_specs=None, out_specs=None)
+                    return fn(q, k, v)
+
+                def ring_attention(q, k, v, mesh):
+                    return _shard_call(_attn_local, q, k, v, mesh)
+                """,
+        })
+        local = _node(g, "ring.py::_attn_local")
+        assert "collective" in local.contexts
+        assert local.tracer_params == {"q", "k", "v"}
+
+    def test_splat_partial_propagates_context_not_taint(self, tmp_path):
+        """A **kwargs splat in the partial can bind ANY parameter --
+        binding is unknowable, so context propagates but no parameter
+        may claim tracer taint (conservatism over coverage)."""
+        g = _graph_of(tmp_path, {
+            "m.py": """
+                import jax
+                from functools import partial
+
+                def _local(x, causal):
+                    return x if causal else -x
+
+                def call(x, mesh, **kw):
+                    fn = jax.shard_map(partial(_local, **kw),
+                                       mesh=mesh, in_specs=None,
+                                       out_specs=None)
+                    return fn(x)
+                """,
+        })
+        local = _node(g, "m.py::_local")
+        assert "collective" in local.contexts
+        assert local.tracer_params == set()
+
+
+class TestDeepRules:
+    def deep(self):
+        from analytics_zoo_tpu.analysis.deep_rules import DeepChecker
+
+        return [DeepChecker()]
+
+    # ---- family 1: transitive trace hazards ------------------------- --
+    def test_transitive_numpy_call_fires_one_call_deep(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import numpy as np
+
+            def helper(z):
+                return np.clip(z, 0, 1)
+
+            @jax.jit
+            def step(x):
+                return helper(x * 2)
+            """, self.deep())
+        assert rules_of(fs) == ["jit-numpy-call"]
+        assert "reached from jit-traced 'step'" in fs[0].message
+
+    def test_same_helper_unreached_from_jit_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import numpy as np
+
+            def helper(z):
+                return np.clip(z, 0, 1)
+
+            def host_loop(x):
+                return helper(x)
+            """, self.deep())
+        assert fs == []
+
+    def test_transitive_concretize_and_branch(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def helper(z):
+                total = jnp.sum(z)
+                if total > 0:
+                    return float(total)
+                return 0.0
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+            """, self.deep())
+        assert rules_of(fs) == ["jit-concretize", "jit-tracer-branch"]
+
+    def test_untainted_param_does_not_fire(self, tmp_path):
+        """The jit caller passes a STATIC value -- the helper's numpy
+        call is host math on a constant, not a trace hazard."""
+        fs = lint(tmp_path, """
+            import jax
+            import numpy as np
+
+            def helper(k):
+                return np.log2(k)
+
+            @jax.jit
+            def step(x):
+                return x * helper(x.shape[0])
+            """, self.deep())
+        assert fs == []
+
+    def test_np_metadata_probe_is_static(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import numpy as np
+
+            def spec_for(z):
+                return np.ndim(z)
+
+            @jax.jit
+            def step(x):
+                return x * spec_for(x)
+            """, self.deep())
+        assert fs == []
+
+    def test_no_double_report_with_old_engine(self, tmp_path):
+        """A hazard in a DIRECTLY jitted body belongs to the PR-4
+        family; running both checkers reports it exactly once."""
+        code = """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                return np.sum(x)
+            """
+        both = lint(tmp_path, code,
+                    [TraceHazardChecker()] + self.deep())
+        assert len(both) == 1
+
+    def test_host_callback_fires_and_suppresses(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return jax.pure_callback(lambda a: a, x, x)
+            """, self.deep())
+        assert rules_of(fs) == ["jit-host-callback-undeclared"]
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                # host metric hook, once per epoch by construction
+                return jax.pure_callback(lambda a: a, x, x)  # zoolint: disable=jit-host-callback-undeclared
+            """, self.deep())
+        assert fs == []
+
+    # ---- family 2: hot-path host syncs ------------------------------ --
+    HOT_TP = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        class ServingWorker:
+            def _dispatch_group(self, group):
+                preds, n = self.model.predict_async(group)
+                return fetch_rows(preds, n)
+
+        def fetch_rows(preds, n):
+            return np.asarray(preds)[:n]
+        """
+
+    def test_hotpath_sync_fires_one_call_deep(self, tmp_path):
+        fs = lint(tmp_path, self.HOT_TP, self.deep())
+        assert rules_of(fs) == ["hotpath-block-on-device"]
+        assert "np.asarray" in fs[0].message
+
+    def test_same_sync_outside_hot_path_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import numpy as np
+
+            class Trainer:
+                def evaluate(self, model, group):
+                    preds, n = model.predict_async(group)
+                    return fetch_rows(preds, n)
+
+            def fetch_rows(preds, n):
+                return np.asarray(preds)[:n]
+            """, self.deep())
+        assert fs == []
+
+    def test_finalize_seam_is_exempt(self, tmp_path):
+        fs = lint(tmp_path, """
+            import numpy as np
+
+            class ServingWorker:
+                def _run_pipelined(self, q):
+                    self._finalize_record(q)
+
+                def _finalize_record(self, rec):
+                    return np.asarray(rec[3]).tolist()
+            """, self.deep())
+        assert fs == []
+
+    def test_host_data_asarray_in_stage_is_clean(self, tmp_path):
+        """np.asarray over DECODED REQUEST tensors (host data) in the
+        decode stage is the engine's bread and butter -- only proven
+        device values fire."""
+        fs = lint(tmp_path, """
+            import numpy as np
+
+            class ServingWorker:
+                def _decode_stage(self, blobs):
+                    return [np.asarray(b) for b in blobs]
+            """, self.deep())
+        assert fs == []
+
+    def test_block_until_ready_always_fires_in_hot_context(
+            self, tmp_path):
+        fs = lint(tmp_path, """
+            class ServingWorker:
+                def _dispatch_group(self, group):
+                    return drain(group)
+
+            def drain(batch):
+                batch.block_until_ready()
+                return batch
+            """, self.deep())
+        assert rules_of(fs) == ["hotpath-block-on-device"]
+
+    # ---- family 3: dtype drift -------------------------------------- --
+    def test_f32_into_bf16_param_fires(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def bn_stat(x, scale=jnp.bfloat16(1.0)):
+                return x * scale
+
+            def caller(x):
+                return bn_stat(x, np.float32(0.5))
+            """, self.deep())
+        assert rules_of(fs) == ["dtype-upcast-f32"]
+
+    def test_weak_python_float_does_not_fire(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def bn_stat(x, scale=jnp.bfloat16(1.0)):
+                return x * scale
+
+            def caller(x):
+                return bn_stat(x, 0.5)
+            """, self.deep())
+        assert fs == []
+
+    def test_f32_array_through_local_alias_fires(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def kern(x, eps=jnp.bfloat16(1e-3)):
+                return x + eps
+
+            def caller(x):
+                e = np.zeros((), np.float32)
+                return kern(x, e)
+            """, self.deep())
+        assert rules_of(fs) == ["dtype-upcast-f32"]
+
+    def test_mixed_collective_fires_single_dtype_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax.numpy as jnp
+            from jax import lax
+
+            def mixed(x, y):
+                return lax.psum(x.astype(jnp.bfloat16)
+                                + y.astype(jnp.float32), "data")
+
+            def uniform(x, y):
+                return lax.psum(x.astype(jnp.bfloat16)
+                                + y.astype(jnp.bfloat16), "data")
+            """, self.deep())
+        assert rules_of(fs) == ["dtype-mixed-collective"]
+        assert len(fs) == 1
+
+    # ---- family 4: version-fragile collective API ------------------- --
+    def test_axis_size_in_propagated_collective_context(self, tmp_path):
+        """THE interprocedural case from the real tree: a plain local
+        body only provably collective through shard_map(partial(...))
+        resolution calls the jax>=0.5-only lax.axis_size."""
+        fs = lint(tmp_path, """
+            import jax
+            from functools import partial
+            from jax import lax
+
+            def _local(params, batch, axis_name):
+                n = lax.axis_size(axis_name)
+                return params, batch, n
+
+            def apply(params, batch, mesh):
+                body = partial(_local, axis_name="stage")
+                fn = jax.shard_map(body, mesh=mesh, in_specs=None,
+                                   out_specs=None)
+                return fn(params, batch)
+            """, self.deep())
+        rules = rules_of(fs)
+        assert "collective-version-api" in rules
+        api = [f for f in fs if f.rule == "collective-version-api"]
+        assert len(api) == 1
+        assert "traced via 'apply'" in api[0].message
+
+    def test_axis_size_unreached_from_collective_is_clean(self,
+                                                          tmp_path):
+        """Same call in a function no shard_map ever traces: not this
+        rule's business (it would be a plain runtime error anyway)."""
+        fs = lint(tmp_path, """
+            from jax import lax
+
+            def host_side(axis_name):
+                return lax.axis_size(axis_name)
+            """, self.deep())
+        assert fs == []
+
+    def test_shard_map_direct_fires_compat_module_exempt(self,
+                                                         tmp_path):
+        """Direct jax.shard_map use (call or import-from) fires
+        anywhere except the one compat wrapper, parallel/mesh.py."""
+        from analytics_zoo_tpu.analysis.core import (
+            Project, collect_files)
+        from analytics_zoo_tpu.analysis.deep_rules import DeepChecker
+
+        files = {
+            "model.py": """
+                import jax
+
+                def run(f, mesh):
+                    return jax.shard_map(f, mesh=mesh, in_specs=None,
+                                         out_specs=None)
+                """,
+            "legacy.py": """
+                from jax.experimental.shard_map import shard_map
+                """,
+            "parallel/mesh.py": """
+                import jax
+
+                def shard_map(f, mesh, in_specs, out_specs):
+                    sm = getattr(jax, "shard_map", None)
+                    if sm is not None:
+                        return sm(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs)
+                    from jax.experimental.shard_map import \\
+                        shard_map as esm
+                    return esm(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs)
+                """,
+        }
+        for rel, code in files.items():
+            path = tmp_path / rel
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(textwrap.dedent(code))
+        parsed, root = collect_files([str(tmp_path)],
+                                     repo_root=str(tmp_path))
+        fs = [f for f in DeepChecker().check_project(
+            Project(parsed, repo_root=root))
+            if f.rule == "shard-map-direct"]
+        assert sorted(f.path for f in fs) == ["legacy.py", "model.py"]
+
+    def test_compat_shard_map_wrapper_use_is_clean(self, tmp_path):
+        """Routing through the compat wrapper -- the fixed form of
+        every real finding -- is exactly what the rule wants."""
+        fs = lint(tmp_path, """
+            from analytics_zoo_tpu.parallel.mesh import shard_map
+
+            def run(f, mesh):
+                return shard_map(f, mesh, in_specs=None,
+                                 out_specs=None)
+            """, self.deep())
+        assert fs == []
+
+    # ---- conservatism / robustness regressions ---------------------- --
+    def test_self_referential_assign_does_not_recurse(self, tmp_path):
+        """``acc = acc + jnp...`` in a hot-path stage: the device walk
+        must terminate (regression: RecursionError killed the whole
+        run) and the accumulated jnp value still counts as device."""
+        fs = lint(tmp_path, """
+            import jax.numpy as jnp
+            import numpy as np
+
+            class ServingWorker:
+                def _dispatch_group(self, group):
+                    acc = jnp.zeros(3)
+                    acc = acc + jnp.ones(3)
+                    buf = group
+                    buf = buf[1:]
+                    np.asarray(buf)  # host value: clean
+                    return np.asarray(acc)
+            """, self.deep())
+        assert rules_of(fs) == ["hotpath-block-on-device"]
+        assert len(fs) == 1
+
+    def test_partial_alias_call_claims_no_bindings(self, tmp_path):
+        """``body = partial(helper, cfg); body(x)`` inside jit: the
+        pre-bound positional shifts the param map, so the edge must
+        claim NO argument bindings (regression: x was bound to the
+        static first param, a false-positive jit-numpy-call)."""
+        fs = lint(tmp_path, """
+            import jax
+            import numpy as np
+            from functools import partial
+
+            def helper(cfg, z):
+                return np.log2(cfg["levels"]) + z
+
+            @jax.jit
+            def step(x):
+                body = partial(helper, {"levels": 4})
+                return body(x)
+            """, self.deep())
+        assert fs == []
+
+    def test_shape_metadata_on_device_value_is_clean(self, tmp_path):
+        """``int(preds.shape[0])`` in a stage reads host metadata --
+        no d2h sync, no finding (regression: the device walk recursed
+        through .shape and flagged it)."""
+        fs = lint(tmp_path, """
+            class ServingWorker:
+                def _dispatch_group(self, group):
+                    preds, n = self.model.predict_async(group)
+                    k = int(preds.shape[0])
+                    return k
+            """, self.deep())
+        assert fs == []
+
+    def test_explicit_dtype_selector_kwarg_is_clean(self, tmp_path):
+        """``dtype=np.float32`` into a ``dtype=jnp.bfloat16``-defaulted
+        param is the caller CHOOSING f32 (master weights idiom), not a
+        silent upcast (regression: flagged as dtype-upcast-f32)."""
+        fs = lint(tmp_path, """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def init_buf(shape, dtype=jnp.bfloat16):
+                return jnp.zeros(shape, dtype)
+
+            def master_weights(shape):
+                return init_buf(shape, dtype=np.float32)
+            """, self.deep())
+        assert fs == []
+
+    def test_nested_def_findings_fire_once(self, tmp_path):
+        """A hazard inside a def nested in a jitted function must be
+        reported exactly once (regression: the parent's walk descended
+        into the nested body and double-reported)."""
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                def inner(y):
+                    return jax.pure_callback(abs, y, y)
+                return inner(x)
+            """, self.deep())
+        assert rules_of(fs) == ["jit-host-callback-undeclared"]
+        assert len(fs) == 1
+
+
+class TestOldEngineMisses:
+    """THE ISSUE-8 acceptance test: hazards one call deep that the
+    PR-4/PR-6 intraprocedural engine cannot see -- each fixture is the
+    minimal form of a pattern from this repo's own history (the
+    pre-pipelining dispatch-stage fetch PR 1 moved into the finalize
+    seam, a helper extracted from a jitted step, an f32 constant
+    flowing into a bf16 kernel, and the pipeline/ring-attention local
+    body whose jax>=0.5-only lax.axis_size -- invisible without
+    shard_map(partial(...)) resolution -- this PR found at 3 real
+    sites and fixed, along with 7 direct jax.shard_map uses)."""
+
+    FIXTURE = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        # 1. the pre-PR-1 serving engine: dispatch stage fetched its
+        #    results synchronously (worker.py's comment: "~0.6 s
+        #    measured on the tunnel -- the serving cycle's dominant
+        #    cost"); one helper-extraction deep, invisible to a
+        #    per-function scan
+        class ServingWorker:
+            def _dispatch_group(self, group):
+                preds, n = self.model.predict_async(group)
+                return rows_of(preds, n)
+
+        def rows_of(preds, n):
+            return np.asarray(preds)[:n]
+
+        # 2. a numpy helper extracted from a jitted step: the PR-4
+        #    scan checks step's own body only
+        def normalize(z):
+            return np.clip(z, 0.0, 1.0)
+
+        @jax.jit
+        def step(x):
+            return normalize(x * 2)
+
+        # 3. the BN-profile upcast: an f32 constant flowing into a
+        #    bf16-defaulted kernel helper (BENCH_NOTES r4: 31% of
+        #    ResNet-50 step time in f32 BN convert fusions)
+        def bn_kernel(x, eps=jnp.bfloat16(1e-3)):
+            return x + eps
+
+        def model_forward(x):
+            return bn_kernel(x, np.float32(1e-3))
+
+        # 4. the pre-deepcheck parallel/ layer, verbatim idiom: a
+        #    plain local body traced through shard_map(partial(...))
+        #    calls the jax>=0.5-only lax.axis_size -- a crash on the
+        #    0.4.x rigs that no per-function scan can connect to the
+        #    collective wrap two hops away (pipeline.py:39 and
+        #    ring_attention.py:83/256 before this PR), plus the direct
+        #    jax.shard_map call itself (absent on 0.4.x)
+        def _pipeline_local(params, batch, stage_fn, axis_name):
+            n_stages = jax.lax.axis_size(axis_name)
+            return stage_fn(params, batch) / n_stages
+
+        def pipeline_apply(params, batch, mesh, stage_fn):
+            from functools import partial
+            body = partial(_pipeline_local, stage_fn=stage_fn,
+                           axis_name="stage")
+            fn = jax.shard_map(body, mesh=mesh, in_specs=None,
+                               out_specs=None)
+            return fn(params, batch)
+        """
+
+    def old_engine(self):
+        return [TraceHazardChecker(), ConcurrencyChecker(),
+                ConfigKeyChecker(), VocabularyChecker(),
+                HygieneChecker(), MeshCollectiveChecker(),
+                ProtocolChecker()]
+
+    def test_old_engine_misses_all_of_them(self, tmp_path):
+        fs = lint(tmp_path, self.FIXTURE, self.old_engine())
+        assert fs == [], [f.render() for f in fs]
+
+    def test_deepcheck_finds_all_of_them(self, tmp_path):
+        from analytics_zoo_tpu.analysis.deep_rules import DeepChecker
+
+        fs = lint(tmp_path, self.FIXTURE, [DeepChecker()])
+        assert rules_of(fs) == ["collective-version-api",
+                                "dtype-upcast-f32",
+                                "hotpath-block-on-device",
+                                "jit-numpy-call",
+                                "shard-map-direct"]
+        assert len(fs) == 5
+
+
+class TestLintBudget:
+    def test_full_tree_lint_under_30s(self):
+        """The whole-package run -- call-graph construction included --
+        must stay a usable gate. 30 s is ~4x the current cost; if this
+        fails, profile callgraph._propagate/_collect_calls before
+        reaching for caching."""
+        import time
+
+        t0 = time.monotonic()
+        run_zoolint([PACKAGE], repo_root=REPO)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 30.0, f"full-tree lint took {elapsed:.1f}s"
