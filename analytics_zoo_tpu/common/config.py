@@ -126,6 +126,33 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.serving.chaos.enabled": False,
     "zoo.serving.chaos.seed": 0,
     "zoo.serving.chaos.spec": "",
+    # graceful drain (ISSUE-9): on SIGTERM (and each rolling-restart
+    # step) the deployment stops pulling new work and finishes its
+    # in-flight requests for up to this budget before exiting
+    # (0 = the old stop-immediately behavior)
+    "zoo.serving.drain.deadline_ms": 10000.0,
+    # serving fleet (serving/fleet.py): N replica launcher processes
+    # sharing one consumer-group stream, front-tier HTTP router, and
+    # an optional metrics-driven autoscaler within
+    # [min_replicas, max_replicas]
+    "zoo.serving.fleet.replicas": 2,
+    "zoo.serving.fleet.min_replicas": 1,
+    "zoo.serving.fleet.max_replicas": 8,
+    "zoo.serving.fleet.poll_interval_s": 0.5,
+    "zoo.serving.fleet.health_interval_s": 1.0,
+    # pending stream entries idle beyond this are reclaimable by any
+    # surviving consumer (XAUTOCLAIM semantics): how long a SIGKILLed
+    # replica's claimed-but-unanswered requests wait before another
+    # replica re-serves them
+    "zoo.serving.fleet.reclaim_idle_ms": 5000.0,
+    "zoo.serving.fleet.router_retries": 1,
+    "zoo.serving.fleet.autoscale.enabled": False,
+    "zoo.serving.fleet.autoscale.backlog_high": 64,
+    "zoo.serving.fleet.autoscale.backlog_low": 4,
+    "zoo.serving.fleet.autoscale.p99_high_ms": 500.0,
+    "zoo.serving.fleet.autoscale.up_consecutive": 3,
+    "zoo.serving.fleet.autoscale.down_consecutive": 10,
+    "zoo.serving.fleet.autoscale.cooldown_s": 10.0,
     # observability (analytics_zoo_tpu.obs): per-request tracing gate
     # (spans ride queue blobs as __trace__ and export as Chrome trace
     # JSON; off by default -- the disabled path must cost nothing),
@@ -215,6 +242,21 @@ _SPECS: Dict[str, tuple] = {
     "zoo.serving.chaos.enabled": ("bool",),
     "zoo.serving.chaos.seed": ("int", None, None),
     "zoo.serving.chaos.spec": ("str",),
+    "zoo.serving.drain.deadline_ms": ("float", 0, None),
+    "zoo.serving.fleet.replicas": ("int", 1, None),
+    "zoo.serving.fleet.min_replicas": ("int", 1, None),
+    "zoo.serving.fleet.max_replicas": ("int", 1, None),
+    "zoo.serving.fleet.poll_interval_s": ("float", 0, None),
+    "zoo.serving.fleet.health_interval_s": ("float", 0, None),
+    "zoo.serving.fleet.reclaim_idle_ms": ("float", 0, None),
+    "zoo.serving.fleet.router_retries": ("int", 0, None),
+    "zoo.serving.fleet.autoscale.enabled": ("bool",),
+    "zoo.serving.fleet.autoscale.backlog_high": ("int", 1, None),
+    "zoo.serving.fleet.autoscale.backlog_low": ("int", 0, None),
+    "zoo.serving.fleet.autoscale.p99_high_ms": ("float", 0, None),
+    "zoo.serving.fleet.autoscale.up_consecutive": ("int", 1, None),
+    "zoo.serving.fleet.autoscale.down_consecutive": ("int", 1, None),
+    "zoo.serving.fleet.autoscale.cooldown_s": ("float", 0, None),
     "zoo.obs.trace.enabled": ("bool",),
     "zoo.obs.trace.max_spans": ("int", 1, None),
     "zoo.obs.report.interval": ("float", 0, None),

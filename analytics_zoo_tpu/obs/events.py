@@ -106,6 +106,29 @@ EVENT_TYPES: Dict[str, str] = {
                       "recipe, quantized_collectives)",
     "serving_stop": "launcher deployment stopped",
     "launch_failed": "launcher aborted mid-assembly (fields: error)",
+    # serving fleet (ISSUE-9)
+    "replica_start": "fleet controller spawned a replica process "
+                     "(fields: name, pid)",
+    "replica_healthy": "a replica's /healthz went green "
+                       "(fields: name, address)",
+    "replica_unhealthy": "a replica failed its health check "
+                         "(fields: name, status)",
+    "replica_exit": "a replica process exited (fields: name, pid, "
+                    "returncode, reason)",
+    "replica_killed": "the controller SIGKILLed a replica "
+                      "(chaos drill or stuck drain; fields: name, "
+                      "pid, reason)",
+    "fleet_scale": "autoscaler (or scale_to) changed the replica "
+                   "count (fields: direction, n_from, n_to, reason)",
+    "rolling_restart": "rolling-restart progress (fields: phase, "
+                       "name)",
+    "drain_begin": "deployment started draining: no new pulls, "
+                   "in-flight work finishing (fields: deadline_ms)",
+    "drain_complete": "drain finished or hit its deadline "
+                      "(fields: ok, waited_s)",
+    "stream_reclaim": "a consumer reclaimed pending stream entries "
+                      "owned by a dead/stalled consumer "
+                      "(fields: stream, group, n)",
     # learn lifecycle
     "train_start": "estimator fit() entered (fields: epochs, "
                    "batch_size)",

@@ -9,9 +9,13 @@ a micro-batcher with bounded backpressure, a serving worker around
 ``InferenceModel``, and a stdlib HTTP frontend with /predict + /metrics.
 Resilience (supervised restarts, circuit breaker, deadlines, load
 shedding) lives in ``resilience``; the deterministic fault-injection
-harness that proves it lives in ``chaos``. The wire vocabulary --
-reserved blob keys and structured error prefixes -- has ONE declaring
-module, ``protocol`` (lint-enforced by zoolint's protocol family).
+harness that proves it lives in ``chaos``. ``fleet`` scales all of it
+horizontally: N replica launcher processes sharding one consumer-group
+stream (``redis_adapter`` stream mode) behind a health-checking HTTP
+router, with drain-based rolling restarts and a metrics-driven
+autoscaler. The wire vocabulary -- reserved blob keys and structured
+error prefixes -- has ONE declaring module, ``protocol``
+(lint-enforced by zoolint's protocol family).
 """
 
 from analytics_zoo_tpu.serving.queues import (  # noqa: F401
@@ -36,6 +40,13 @@ from analytics_zoo_tpu.serving.http_frontend import (  # noqa: F401
 )
 from analytics_zoo_tpu.serving.redis_adapter import (  # noqa: F401
     RedisFrontend,
+    RedisStreamQueue,
+    StreamStore,
+)
+from analytics_zoo_tpu.serving.fleet import (  # noqa: F401
+    Autoscaler,
+    FleetController,
+    FleetRouter,
 )
 from analytics_zoo_tpu.serving.resilience import (  # noqa: F401
     CircuitBreaker,

@@ -20,6 +20,8 @@ decode    top of ``ServingWorker._decode_stage``
 dispatch  top of ``ServingWorker._dispatch_group``
 finalize  top of ``ServingWorker._finalize_record``
 push      result push (returns True = drop this reply)
+replica   fleet controller, once per routed/observed result
+          (returns True = SIGKILL a whole replica process)
 ========  ====================================================
 
 Injector kinds:
@@ -33,7 +35,10 @@ Injector kinds:
 - ``sleep``: block the stage for ``dur`` seconds (wedge / slow
   backend / queue stall depending on the seam);
 - ``drop``: at the ``push`` seam, swallow the reply (lost-result
-  path; clients observe a timeout).
+  path; clients observe a timeout);
+- ``kill``: at the ``replica`` seam only (ISSUE-9) -- tells the fleet
+  controller to SIGKILL one whole replica process mid-run, the
+  process-granular fault PR 5's in-process harness could not model.
 
 Spec grammar (``zoo.serving.chaos.spec``, entries ``;``-separated)::
 
@@ -70,8 +75,8 @@ _M_INJECTED = get_registry().counter(
     "Chaos faults injected, by seam and kind",
     labelnames=("seam", "kind"))
 
-SEAMS = ("pull", "decode", "dispatch", "finalize", "push")
-KINDS = ("crash", "error", "sleep", "drop")
+SEAMS = ("pull", "decode", "dispatch", "finalize", "push", "replica")
+KINDS = ("crash", "error", "sleep", "drop", "kill")
 
 
 class ChaosError(Exception):
@@ -99,6 +104,14 @@ class ChaosRule:
                              f"(one of {', '.join(SEAMS)})")
         if kind == "drop" and seam != "push":
             raise ValueError("drop rules only apply to the push seam")
+        # replica-level chaos (ISSUE-9) is process-granular: only the
+        # fleet controller can act on it, and in-process kinds make no
+        # sense there -- the pairing is exclusive both ways
+        if (kind == "kill") != (seam == "replica"):
+            raise ValueError(
+                "kill rules pair exclusively with the replica seam "
+                "(kill:replica:at=N -- the fleet controller SIGKILLs "
+                "a whole replica process)")
         self.kind = kind
         self.seam = seam
         self.at = at
@@ -189,7 +202,10 @@ class ChaosInjector:
             elif rule.kind == "crash":
                 raise ChaosCrash(f"chaos: injected crash at {seam} "
                                  f"(call {n})")
-            elif rule.kind == "drop":
+            elif rule.kind in ("drop", "kill"):
+                # both are act-by-return-value kinds: the caller knows
+                # its seam -- push drops the reply it was about to
+                # send, the fleet controller SIGKILLs a replica
                 drop = True
         return drop
 

@@ -1,0 +1,1006 @@
+"""Serving fleet: replicated worker processes behind one front tier.
+
+The reference platform's headline serving story is a *fleet*: Flink
+fans one Redis stream across many inference consumers and a frontend
+load-balances direct traffic (PAPER.md section "serving"; BigDL 2.0,
+arXiv:2204.01715). PR 5 made ONE worker process crash-safe; this
+module (ISSUE-9) removes the last single point of failure by running
+N of them:
+
+- :class:`FleetController` -- spawns N replicas of the supervised
+  launcher as separate OS processes (manager.py's /proc-identity
+  machinery guards every signal), hosts the shared stream broker
+  (``redis_adapter`` in stream mode), restarts dead replicas with
+  capped backoff, rolls restarts one replica at a time behind a drain
+  (capacity never drops below N-1), and scales the replica set within
+  ``[min, max]`` on the :class:`Autoscaler`'s decisions.
+- **Stream sharding** -- every replica is one consumer-group member on
+  the broker's request stream (``RedisStreamQueue``): each request is
+  claimed by exactly one replica, acked when its reply is pushed, and
+  reclaimed by a survivor when the claimant dies un-acked
+  (XAUTOCLAIM past ``zoo.serving.fleet.reclaim_idle_ms``) -- so a
+  SIGKILLed replica loses no requests and answers none twice.
+- :class:`FleetRouter` -- the front tier for direct HTTP traffic:
+  round-robins /predict over replicas whose ``/healthz`` is green,
+  and retries a request that hit a dead replica's socket **exactly
+  once** on another replica (PR 5's RequestLedger policy lifted to
+  the fleet level: one retry, then one structured
+  ``replica_unavailable`` error).
+- **Replica-level chaos** -- ``kill:replica:at=N`` in the chaos spec
+  makes the controller SIGKILL a whole replica after the Nth observed
+  result (seeded, deterministic); ``scripts/fleet_soak.py`` proves
+  every request is still answered exactly once.
+
+Everything here runs in the controller process; replicas are plain
+``python -m analytics_zoo_tpu.serving.launcher`` deployments (drain on
+SIGTERM, supervised worker, own HTTP frontend) -- the fleet is an
+arrangement of already-hardened pieces, not a second serving engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.obs.events import emit as emit_event
+from analytics_zoo_tpu.obs.metrics import get_registry
+from analytics_zoo_tpu.serving.chaos import chaos_point
+from analytics_zoo_tpu.serving.manager import _proc_identity
+from analytics_zoo_tpu.serving.protocol import REPLICA_PREFIX
+from analytics_zoo_tpu.serving.redis_adapter import RedisFrontend
+
+logger = get_logger(__name__)
+
+_REG = get_registry()
+_M_REPLICAS = _REG.gauge(
+    "zoo_fleet_replicas_items",
+    "Fleet replica counts, by state (running = process alive, "
+    "healthy = /healthz green)", labelnames=("state",))
+_M_RESTARTS = _REG.counter(
+    "zoo_fleet_replica_restarts_total",
+    "Replica processes restarted by the controller, by reason",
+    labelnames=("reason",))
+_M_ROUTER_REQS = _REG.counter(
+    "zoo_fleet_router_requests_total",
+    "Front-tier router requests, by HTTP status answered",
+    labelnames=("code",))
+_M_ROUTER_RETRIES = _REG.counter(
+    "zoo_fleet_router_retries_total",
+    "Predict requests retried on another replica after a dead "
+    "replica's connection failed")
+_M_SCALE = _REG.counter(
+    "zoo_fleet_scale_actions_total",
+    "Autoscaler / scale_to replica-set changes, by direction",
+    labelnames=("direction",))
+
+
+class Replica:
+    """One replica process the controller owns: spawn identity (the
+    manager's /proc fingerprint, so a recycled pid is never signaled),
+    readiness/address channel, and routing state."""
+
+    def __init__(self, name: str, config_path: str, ready_file: str,
+                 log_path: str):
+        self.name = name
+        self.config_path = config_path
+        self.ready_file = ready_file
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.identity = None
+        self.address: Optional[str] = None
+        self.state = "starting"   # starting | up | stopping | stopped
+        self.healthy = False
+        self.quiesced = False     # router must skip (drain prelude)
+        self.started_at = 0.0
+        self.restarts = 0
+        self.kill_reason: Optional[str] = None
+        self.respawn_at = 0.0  # while state == "backoff"
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def routable(self) -> bool:
+        return (self.state == "up" and self.healthy
+                and not self.quiesced and self.address is not None)
+
+
+class Autoscaler:
+    """Hysteresis-gated scaling decisions from fleet load signals.
+
+    Pure decision logic (injectable clock, no I/O) so tests can drive
+    oscillating load through it directly. A sample is *overloaded*
+    when stream backlog, shed rate, or p99 breaches its high mark, and
+    *underloaded* only when every signal is comfortably low; anything
+    in between is the dead band that resets both streaks. Scaling
+    needs ``up_consecutive`` (resp. ``down_consecutive``) breaches in
+    a row AND an expired cooldown -- an oscillating load that never
+    holds a breach that long moves nothing (the no-flapping
+    property). Bounds clamp to ``[min_replicas, max_replicas]``."""
+
+    def __init__(self, min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 backlog_high: Optional[int] = None,
+                 backlog_low: Optional[int] = None,
+                 p99_high_ms: Optional[float] = None,
+                 up_consecutive: Optional[int] = None,
+                 down_consecutive: Optional[int] = None,
+                 cooldown_s: Optional[float] = None, clock=None):
+        cfg = get_config()
+
+        def _get(val, key, cast):
+            return cast(cfg.get(key) if val is None else val)
+
+        self.min_replicas = _get(min_replicas,
+                                 "zoo.serving.fleet.min_replicas", int)
+        self.max_replicas = _get(max_replicas,
+                                 "zoo.serving.fleet.max_replicas", int)
+        self.backlog_high = _get(
+            backlog_high, "zoo.serving.fleet.autoscale.backlog_high",
+            int)
+        self.backlog_low = _get(
+            backlog_low, "zoo.serving.fleet.autoscale.backlog_low",
+            int)
+        self.p99_high_ms = _get(
+            p99_high_ms, "zoo.serving.fleet.autoscale.p99_high_ms",
+            float)
+        self.up_consecutive = _get(
+            up_consecutive,
+            "zoo.serving.fleet.autoscale.up_consecutive", int)
+        self.down_consecutive = _get(
+            down_consecutive,
+            "zoo.serving.fleet.autoscale.down_consecutive", int)
+        self.cooldown_s = _get(
+            cooldown_s, "zoo.serving.fleet.autoscale.cooldown_s",
+            float)
+        self._clock = clock or time.monotonic
+        self._over = 0
+        self._under = 0
+        self._last_action = None  # monotonic stamp of the last +-1
+
+    def decide(self, n_replicas: int, backlog: int,
+               shed_rate: float = 0.0,
+               p99_ms: Optional[float] = None) -> int:
+        """One sample in, one of (-1, 0, +1) out."""
+        over = (backlog > self.backlog_high or shed_rate > 0
+                or (self.p99_high_ms > 0 and p99_ms is not None
+                    and p99_ms > self.p99_high_ms))
+        under = (backlog <= self.backlog_low and shed_rate <= 0
+                 and (p99_ms is None or self.p99_high_ms <= 0
+                      or p99_ms < self.p99_high_ms / 2))
+        if over:
+            self._over += 1
+            self._under = 0
+        elif under:
+            self._under += 1
+            self._over = 0
+        else:  # dead band: a load that wobbles around the marks must
+            self._over = 0     # re-earn a full streak in either
+            self._under = 0    # direction before anything moves
+        now = self._clock()
+        if (self._last_action is not None
+                and now - self._last_action < self.cooldown_s):
+            return 0
+        if self._over >= self.up_consecutive:
+            if n_replicas >= self.max_replicas:
+                return 0
+            self._over = 0
+            self._last_action = now
+            return 1
+        if self._under >= self.down_consecutive:
+            if n_replicas <= self.min_replicas:
+                return 0
+            self._under = 0
+            self._last_action = now
+            return -1
+        return 0
+
+    def stats(self) -> Dict[str, Any]:
+        return {"over_streak": self._over, "under_streak": self._under,
+                "min": self.min_replicas, "max": self.max_replicas}
+
+
+class FleetRouter:
+    """Front-tier HTTP router: the one address clients talk to.
+
+    ``POST /predict`` round-robins over routable replicas (healthy,
+    not quiesced) and relays the replica's response verbatim. A
+    connection-level failure (refused/reset -- the replica died under
+    us) marks the replica unhealthy and retries the request on a
+    different replica at most ``zoo.serving.fleet.router_retries``
+    times (default 1, PR 5's exactly-once retry policy at fleet
+    level); a reply timeout is NOT retried -- the request may be
+    mid-serve, and a retry would double-serve it. ``GET /healthz``
+    summarizes fleet health, ``/metrics`` + ``/metrics.json`` expose
+    the controller-process registry and fleet stats."""
+
+    def __init__(self, controller: "FleetController",
+                 host: str = "127.0.0.1", port: int = 0,
+                 retries: Optional[int] = None,
+                 timeout_s: float = 30.0):
+        self.controller = controller
+        self.retries = int(
+            get_config().get("zoo.serving.fleet.router_retries", 1)
+            if retries is None else retries)
+        self.timeout_s = float(timeout_s)
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug("fleet router: " + fmt, *args)
+
+            def _reply(self, code: int, body: bytes,
+                       content_type: str = "application/json"):
+                _M_ROUTER_REQS.labels(code=str(code)).inc()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path.split("?")[0] != "/predict":
+                    self._reply(404, json.dumps(
+                        {"error": "not found"}).encode())
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                code, payload = router.forward_predict(body)
+                self._reply(code, payload)
+
+            def do_GET(self):
+                route = self.path.split("?")[0]
+                if route == "/healthz":
+                    code, payload = router.health()
+                    self._reply(code, json.dumps(payload).encode())
+                elif route == "/metrics":
+                    self._reply(
+                        200,
+                        get_registry().prometheus_text().encode(),
+                        content_type="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                elif route == "/metrics.json":
+                    self._reply(200, json.dumps(
+                        router.metrics()).encode())
+                else:
+                    self._reply(404, json.dumps(
+                        {"error": "not found"}).encode())
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FleetRouter":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="fleet-router")
+        self._thread.start()
+        logger.info("fleet router at %s", self.address)
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        self._server.server_close()
+
+    # ------------------------------------------------------ forwarding --
+    @staticmethod
+    def _connect_probe(address: str, timeout_s: float = 2.0) -> None:
+        """TCP-connect to the replica before sending the request: a
+        connect-phase failure (refused, reset, OR a black-holing dead
+        host timing out) provably never delivered anything, so it is
+        duplicate-safe to retry on another replica -- unlike a
+        reply-phase timeout, where the request may be mid-serve. One
+        extra loopback/LAN handshake per forward buys that
+        distinction, which urllib's single timeout cannot make."""
+        import urllib.parse
+
+        parts = urllib.parse.urlsplit(address)
+        sock = socket.create_connection(
+            (parts.hostname, parts.port), timeout=timeout_s)
+        sock.close()
+
+    def forward_predict(self, body: bytes):
+        tried: List[str] = []
+        for attempt in range(self.retries + 1):
+            rep = self.controller.pick_replica(exclude=tried)
+            if rep is None:
+                break
+            tried.append(rep.name)
+            try:
+                # probe failures (refused, reset, black-hole timeout)
+                # are all pre-delivery: safe to retry elsewhere
+                self._connect_probe(rep.address)
+            except OSError as e:
+                self.controller.mark_unhealthy(
+                    rep, f"connect probe failed: {e}")
+                if attempt < self.retries:
+                    _M_ROUTER_RETRIES.inc()
+                    logger.warning(
+                        "replica %s unreachable (%s); retrying once "
+                        "on another replica", rep.name, e)
+                continue
+            try:
+                req = urllib.request.Request(
+                    rep.address + "/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as e:
+                if e.code == 503 and attempt < self.retries:
+                    # a 503 is a REFUSAL (draining replica caught
+                    # mid-quiesce, shedding, open breaker): the
+                    # request was provably not served, so trying the
+                    # next replica is duplicate-safe -- and it closes
+                    # the quiesce-vs-in-flight race that would
+                    # otherwise leak a 503 through a rolling restart.
+                    # The replica stays healthy: refusing is policy,
+                    # not death.
+                    _M_ROUTER_RETRIES.inc()
+                    e.read()
+                    continue
+                # any other answer (4xx/5xx): relay verbatim -- an
+                # application-level response is not a dead replica
+                return e.code, e.read()
+            except (urllib.error.URLError, ConnectionError,
+                    socket.timeout, OSError) as e:
+                reason = getattr(e, "reason", e)
+                if isinstance(reason, socket.timeout):
+                    # mid-serve timeout: retrying could double-serve;
+                    # surface the timeout instead
+                    return 504, json.dumps(
+                        {"error": "prediction timed out at replica "
+                                  f"{rep.name}"}).encode()
+                self.controller.mark_unhealthy(
+                    rep, f"connection failed: {reason}")
+                if attempt < self.retries:
+                    _M_ROUTER_RETRIES.inc()
+                    logger.warning(
+                        "replica %s connection failed (%s); retrying "
+                        "once on another replica", rep.name, reason)
+        return 503, json.dumps(
+            {"error": REPLICA_PREFIX,
+             "detail": f"{REPLICA_PREFIX}: no healthy replica "
+                       f"answered (tried {tried or 'none'})",
+             "retry_after_s": 1}).encode()
+
+    def health(self):
+        counts = self.controller.replica_states()
+        healthy = counts.get("healthy", 0)
+        return (200 if healthy > 0 else 503), {
+            "status": "ok" if healthy > 0 else "no_healthy_replicas",
+            "replicas": counts,
+        }
+
+    def metrics(self) -> Dict[str, Any]:
+        return {"fleet": self.controller.stats(),
+                "registry": get_registry().snapshot()}
+
+
+class FleetController:
+    """Owns the fleet: broker, N replica processes, router, scaling.
+
+    ``config`` is the per-replica serving YAML dict (model/params/
+    shard); the controller overwrites its ``data:`` block to point at
+    the hosted broker with a per-replica consumer name and enables the
+    per-replica HTTP frontend on a free port. Replicas report their
+    address through the launcher's ``--ready-file``."""
+
+    def __init__(self, config: Dict[str, Any],
+                 replicas: Optional[int] = None,
+                 work_dir: Optional[str] = None,
+                 host: str = "127.0.0.1", broker_port: int = 0,
+                 router_port: int = 0,
+                 stream: str = "serving_stream",
+                 group: str = "serving",
+                 seed: int = 0,
+                 autoscale: Optional[bool] = None,
+                 autoscaler: Optional[Autoscaler] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 on_result: Optional[Callable] = None,
+                 poll_interval_s: Optional[float] = None,
+                 health_interval_s: Optional[float] = None):
+        cfg = get_config()
+        self.config = dict(config)
+        self.n_target = int(cfg.get("zoo.serving.fleet.replicas", 2)
+                            if replicas is None else replicas)
+        if work_dir is None:
+            import tempfile
+
+            work_dir = tempfile.mkdtemp(prefix="zoo-fleet-")
+        self.work_dir = work_dir
+        os.makedirs(work_dir, exist_ok=True)
+        self.host = host
+        self._broker_port = broker_port
+        self._router_port = router_port
+        self.stream = stream
+        self.group = group
+        self.poll_interval_s = float(
+            cfg.get("zoo.serving.fleet.poll_interval_s", 0.5)
+            if poll_interval_s is None else poll_interval_s)
+        self.health_interval_s = float(
+            cfg.get("zoo.serving.fleet.health_interval_s", 1.0)
+            if health_interval_s is None else health_interval_s)
+        self.autoscale = bool(
+            cfg.get("zoo.serving.fleet.autoscale.enabled", False)
+            if autoscale is None else autoscale)
+        self.autoscaler = autoscaler or (Autoscaler()
+                                         if self.autoscale else None)
+        self._env = dict(os.environ)
+        self._env.update(env or {})
+        # replicas run `python -m analytics_zoo_tpu...` from their own
+        # cwd: the package root must ride PYTHONPATH explicitly, or
+        # spawning only works when the CONTROLLER happens to run from
+        # the repo root (python -m puts cwd on sys.path)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        existing = self._env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            self._env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + existing if existing else ""))
+        self._on_result = on_result
+        self._rng = random.Random(seed)
+        self._lock = threading.RLock()
+        self._replicas: Dict[str, Replica] = {}
+        self._next_idx = 0
+        self._rr = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_health = 0.0
+        self._last_shed_total = 0.0
+        self.broker: Optional[RedisFrontend] = None
+        self.router: Optional[FleetRouter] = None
+        self.results_observed = 0
+        self.chaos_kills = 0
+        # capacity proof for rolling restarts: while one is active the
+        # health tick records the minimum healthy count it saw
+        self._rolling = False
+        self.min_healthy_during_restart: Optional[int] = None
+
+    # --------------------------------------------------------- lifecycle --
+    @property
+    def broker_address(self) -> str:
+        return f"{self.broker.host}:{self.broker.port}"
+
+    def start(self) -> "FleetController":
+        self.broker = RedisFrontend(
+            host=self.host, port=self._broker_port, name=self.stream,
+            result_callback=self._result_observed).serve()
+        for _ in range(self.n_target):
+            self._spawn()
+        self.router = FleetRouter(self, host=self.host,
+                                  port=self._router_port).start()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._control_loop,
+                                        daemon=True,
+                                        name="fleet-controller")
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = False) -> None:
+        """Tear the fleet down. ``drain=True`` SIGTERMs replicas and
+        lets each finish in-flight work under its drain deadline;
+        False is the fast path for tests/soaks that already drained
+        the workload."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        if self.router is not None:
+            self.router.stop()
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            self._terminate(rep, reason="fleet_stop", drain=drain)
+        if self.broker is not None:
+            self.broker.stop()
+        self._update_gauges()
+
+    # ----------------------------------------------------------- spawn --
+    def _replica_config(self, name: str) -> Dict[str, Any]:
+        cfg = json.loads(json.dumps(self.config))  # deep copy
+        cfg["data"] = {"queue": "redis", "path": self.broker_address,
+                       "stream": self.stream, "group": self.group,
+                       "consumer": name}
+        http = dict(cfg.get("http") or {})
+        http.setdefault("enabled", True)
+        http["port"] = 0  # every replica picks a free port
+        cfg["http"] = http
+        cfg["name"] = name
+        return cfg
+
+    def _spawn(self, name: Optional[str] = None) -> Replica:
+        import yaml
+
+        with self._lock:
+            if name is None:
+                name = f"r{self._next_idx}"
+                self._next_idx += 1
+        config_path = os.path.join(self.work_dir, f"{name}.yaml")
+        ready_file = os.path.join(self.work_dir, f"{name}.ready.json")
+        log_path = os.path.join(self.work_dir, f"{name}.log")
+        with open(config_path, "w") as f:
+            yaml.safe_dump(self._replica_config(name), f)
+        try:
+            os.unlink(ready_file)  # a stale address must never route
+        except FileNotFoundError:
+            pass
+        rep = Replica(name, config_path, ready_file, log_path)
+        log_f = open(log_path, "ab")
+        rep.proc = subprocess.Popen(
+            [sys.executable, "-m", "analytics_zoo_tpu.serving.launcher",
+             "-c", config_path, "--ready-file", ready_file],
+            stdout=log_f, stderr=subprocess.STDOUT,
+            start_new_session=True, env=self._env)
+        log_f.close()
+        rep.identity = _proc_identity(rep.proc.pid)
+        rep.started_at = time.monotonic()
+        with self._lock:
+            self._replicas[name] = rep
+        emit_event("replica_start", "serving", name=name,
+                   pid=rep.proc.pid)
+        logger.info("spawned replica %s (pid %d)", name, rep.proc.pid)
+        self._update_gauges()
+        return rep
+
+    # ------------------------------------------------------ supervision --
+    def _control_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self._supervise_tick()
+                now = time.monotonic()
+                if now - self._last_health >= self.health_interval_s:
+                    self._last_health = now
+                    self._health_tick()
+                    if self.autoscaler is not None and self.autoscale:
+                        self._autoscale_tick()
+            except Exception as e:  # the control loop must survive
+                logger.exception("fleet control tick failed: %s", e)
+
+    def _supervise_tick(self) -> None:
+        with self._lock:
+            reps = list(self._replicas.values())
+        now = time.monotonic()
+        for rep in reps:
+            if rep.state == "backoff":
+                # scheduled respawn (never slept inline: one replica's
+                # backoff must not stall supervision of the others)
+                if now >= rep.respawn_at:
+                    new = self._spawn(rep.name)
+                    new.restarts = rep.restarts
+                    self._update_gauges()
+                continue
+            if rep.proc is None or rep.state in ("stopping", "stopped"):
+                continue
+            if rep.address is None and os.path.isfile(rep.ready_file):
+                try:
+                    with open(rep.ready_file) as f:
+                        ready = json.load(f)
+                    rep.address = ready.get("address")
+                    rep.state = "up"
+                    logger.info("replica %s ready at %s", rep.name,
+                                rep.address)
+                except (OSError, ValueError) as e:
+                    logger.debug("ready file for %s unreadable: %s",
+                                 rep.name, e)
+            rc = rep.proc.poll()
+            if rc is None:
+                continue
+            # unexpected exit (SIGKILL chaos, OOM, crash the in-process
+            # supervisor could not absorb): restart in place with a
+            # small capped backoff
+            reason = rep.kill_reason or "crashed"
+            rep.kill_reason = None
+            rep.healthy = False
+            emit_event("replica_exit", "serving", name=rep.name,
+                       pid=rep.pid, returncode=rc, reason=reason)
+            _M_RESTARTS.labels(reason=reason).inc()
+            rep.restarts += 1
+            backoff = min(2.0, 0.05 * (2 ** min(rep.restarts - 1, 6)))
+            backoff *= 0.5 + self._rng.random() * 0.5
+            rep.state = "backoff"
+            rep.respawn_at = now + backoff
+            logger.warning(
+                "replica %s exited (rc=%s, %s); restarting in %.2fs "
+                "(restart #%d)", rep.name, rc, reason, backoff,
+                rep.restarts)
+            self._update_gauges()
+
+    def _health_tick(self) -> None:
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if rep.state != "up" or rep.address is None:
+                continue
+            was = rep.healthy
+            healthy, status = self._probe(rep)
+            rep.healthy = healthy
+            if healthy and not was:
+                emit_event("replica_healthy", "serving", name=rep.name,
+                           address=rep.address)
+            elif was and not healthy:
+                emit_event("replica_unhealthy", "serving",
+                           name=rep.name, status=status)
+                logger.warning("replica %s unhealthy: %s", rep.name,
+                               status)
+        self._update_gauges()
+        if self._rolling:
+            n = self.healthy_count()
+            if (self.min_healthy_during_restart is None
+                    or n < self.min_healthy_during_restart):
+                self.min_healthy_during_restart = n
+
+    def _probe(self, rep: Replica):
+        try:
+            with urllib.request.urlopen(rep.address + "/healthz",
+                                        timeout=2.0) as resp:
+                return resp.status == 200, "ok"
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("status", "")
+            except (ValueError, OSError):
+                detail = ""
+            return False, f"http {e.code} {detail}".strip()
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            return False, f"unreachable: {getattr(e, 'reason', e)}"
+
+    def mark_unhealthy(self, rep: Replica, why: str) -> None:
+        """Router feedback: a connection-level failure outranks the
+        last health poll (the poll is eventually consistent; the
+        router just witnessed the truth)."""
+        if rep.healthy:
+            rep.healthy = False
+            emit_event("replica_unhealthy", "serving", name=rep.name,
+                       status=why[:200])
+        self._update_gauges()
+
+    # --------------------------------------------------------- routing --
+    def pick_replica(self, exclude=()) -> Optional[Replica]:
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.routable() and r.name not in exclude]
+            if not candidates:
+                return None
+            self._rr += 1
+            return candidates[self._rr % len(candidates)]
+
+    def replica_states(self) -> Dict[str, int]:
+        with self._lock:
+            reps = list(self._replicas.values())
+        return {
+            "total": len(reps),
+            "running": sum(1 for r in reps
+                           if r.proc is not None
+                           and r.proc.poll() is None),
+            "healthy": sum(1 for r in reps if r.healthy),
+            "quiesced": sum(1 for r in reps if r.quiesced),
+        }
+
+    def healthy_count(self) -> int:
+        return self.replica_states()["healthy"]
+
+    def _update_gauges(self) -> None:
+        counts = self.replica_states()
+        _M_REPLICAS.labels(state="running").set(counts["running"])
+        _M_REPLICAS.labels(state="healthy").set(counts["healthy"])
+
+    # ----------------------------------------------------- chaos seam --
+    def _result_observed(self, uri: str, tensors) -> None:
+        """Broker drain callback: one call per result entry consumed
+        into the result table -- the deterministic tick the replica
+        chaos seam counts on (``kill:replica:at=N`` = SIGKILL after
+        the Nth observed result)."""
+        self.results_observed += 1
+        if self._on_result is not None:
+            self._on_result(uri, tensors)
+        if chaos_point("replica"):
+            self.chaos_kill()
+
+    def chaos_kill(self) -> Optional[str]:
+        """SIGKILL one seeded-random live replica (the chaos drill's
+        process-granular fault). Returns the victim's name."""
+        with self._lock:
+            live = sorted(
+                (r for r in self._replicas.values()
+                 if r.proc is not None and r.proc.poll() is None
+                 and r.state == "up"),
+                key=lambda r: r.name)
+        if not live:
+            return None
+        rep = self._rng.choice(live)
+        if not self.kill_replica(rep.name, reason="chaos"):
+            return None
+        self.chaos_kills += 1
+        return rep.name
+
+    @staticmethod
+    def _identity_matches(rep: Replica) -> bool:
+        """STARTTIME-only /proc identity check (the manager.py rule):
+        two processes can share a recycled pid, never a
+        (pid, starttime) pair. The cmdline is deliberately excluded --
+        it legitimately changes between the fork-time snapshot and
+        exec, so comparing it would refuse to signal our own
+        freshly-spawned replica."""
+        if rep.identity is None or rep.proc is None:
+            return True  # no /proc at spawn: cannot disprove
+        now = _proc_identity(rep.proc.pid)
+        return now is None or now[0] == rep.identity[0]
+
+    def kill_replica(self, name: str, reason: str = "drill") -> bool:
+        """Immediate SIGKILL -- no drain, no warning; the supervision
+        loop restarts it and the broker's pending-entry reclaim
+        re-serves whatever it had claimed."""
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is None or rep.proc is None or rep.proc.poll() is not None:
+            return False
+        if not self._identity_matches(rep):
+            logger.warning("replica %s pid %s identity changed; not "
+                           "signaling", name, rep.proc.pid)
+            return False
+        rep.kill_reason = reason
+        rep.healthy = False
+        emit_event("replica_killed", "serving", name=name,
+                   pid=rep.proc.pid, reason=reason)
+        logger.warning("SIGKILL replica %s (pid %d, %s)", name,
+                       rep.proc.pid, reason)
+        try:
+            os.kill(rep.proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError) as e:
+            logger.info("kill of %s failed: %s", name, e)
+            return False
+        return True
+
+    # ------------------------------------------------- drain / restart --
+    def _terminate(self, rep: Replica, reason: str,
+                   drain: bool = True,
+                   timeout_s: Optional[float] = None) -> None:
+        """Graceful stop of one replica: quiesce at the router,
+        SIGTERM (the launcher drains in-process under
+        ``zoo.serving.drain.deadline_ms``), escalate to SIGKILL only
+        past the deadline + grace."""
+        if timeout_s is None:
+            deadline_ms = float(get_config().get(
+                "zoo.serving.drain.deadline_ms", 10000.0))
+            timeout_s = deadline_ms / 1000.0 + 10.0
+        rep.quiesced = True
+        rep.state = "stopping"
+        proc = rep.proc
+        if proc is None or proc.poll() is not None:
+            rep.state = "stopped"
+            return
+        if not self._identity_matches(rep):
+            rep.state = "stopped"
+            return  # recycled pid: never signal a stranger
+        try:
+            proc.send_signal(signal.SIGTERM if drain
+                             else signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            rep.state = "stopped"
+            return
+        try:
+            proc.wait(timeout=timeout_s if drain else 10.0)
+        except subprocess.TimeoutExpired:
+            logger.warning("replica %s ignored SIGTERM for %.1fs; "
+                           "SIGKILL", rep.name, timeout_s)
+            emit_event("replica_killed", "serving", name=rep.name,
+                       pid=proc.pid, reason="drain_timeout")
+            proc.kill()
+            proc.wait(timeout=10.0)
+        rep.healthy = False
+        rep.state = "stopped"
+        emit_event("replica_exit", "serving", name=rep.name,
+                   pid=proc.pid, returncode=proc.returncode,
+                   reason=reason)
+
+    def wait_healthy(self, n: Optional[int] = None,
+                     timeout_s: float = 120.0) -> bool:
+        """Block until >= n replicas are healthy (default: the full
+        target)."""
+        n = self.n_target if n is None else n
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.healthy_count() >= n:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def wait_replica_healthy(self, name: str,
+                             timeout_s: float = 120.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                rep = self._replicas.get(name)
+            if rep is not None and rep.healthy:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def rolling_restart(self, timeout_s: float = 180.0) -> bool:
+        """Restart every replica, one at a time, each behind a drain:
+        quiesce at the router -> SIGTERM (in-process drain) -> wait
+        exit -> respawn under the same consumer name -> wait healthy.
+        At most one replica is ever down, so serving capacity stays
+        >= N-1 throughout; ``min_healthy_during_restart`` records the
+        health tick's observed floor as evidence. Returns True when
+        every replica came back healthy."""
+        emit_event("rolling_restart", "serving", phase="begin",
+                   name=None)
+        self._rolling = True
+        self.min_healthy_during_restart = self.healthy_count()
+        ok = True
+        with self._lock:
+            names = sorted(self._replicas)
+        try:
+            for name in names:
+                emit_event("rolling_restart", "serving",
+                           phase="replica", name=name)
+                with self._lock:
+                    rep = self._replicas.get(name)
+                if rep is None:
+                    continue
+                self._terminate(rep, reason="rolling_restart",
+                                drain=True)
+                _M_RESTARTS.labels(reason="rolling").inc()
+                restarts = rep.restarts + 1
+                new = self._spawn(name)
+                new.restarts = restarts
+                if not self.wait_replica_healthy(name,
+                                                 timeout_s=timeout_s):
+                    logger.error("replica %s did not come back "
+                                 "healthy after rolling restart", name)
+                    ok = False
+        finally:
+            self._rolling = False
+            emit_event("rolling_restart", "serving", phase="end",
+                       name=None)
+        return ok
+
+    # --------------------------------------------------------- scaling --
+    def scale_to(self, n: int, reason: str = "manual") -> int:
+        """Grow or shrink the replica set to ``n`` (clamped to the
+        autoscaler's bounds when one is attached). Shrinking drains:
+        the victims finish in-flight work before exiting, and their
+        un-started claims reclaim to survivors."""
+        if self.autoscaler is not None:
+            n = max(self.autoscaler.min_replicas,
+                    min(self.autoscaler.max_replicas, n))
+        n = max(1, int(n))
+        with self._lock:
+            current = {name: rep for name, rep in self._replicas.items()
+                       if rep.state != "stopped"}
+        delta = n - len(current)
+        if delta == 0:
+            return 0
+        direction = "up" if delta > 0 else "down"
+        emit_event("fleet_scale", "serving", direction=direction,
+                   n_from=len(current), n_to=n, reason=reason)
+        _M_SCALE.labels(direction=direction).inc()
+        logger.info("scaling %s: %d -> %d replicas (%s)", direction,
+                    len(current), n, reason)
+        if delta > 0:
+            for _ in range(delta):
+                self._spawn()
+        else:
+            # newest first: the oldest replicas have the warmest
+            # caches and the longest uptime record
+            victims = sorted(current.values(),
+                             key=lambda r: r.started_at)[delta:]
+            for rep in victims:
+                # quiesce SYNCHRONOUSLY (the router must stop routing
+                # here before this call returns), then drain on a
+                # side thread: a busy victim's drain can take the
+                # whole deadline, and blocking the control loop that
+                # long would stall crash restarts and health probes
+                # for every OTHER replica
+                rep.quiesced = True
+                rep.state = "stopping"
+                threading.Thread(
+                    target=self._drain_victim, args=(rep,),
+                    daemon=True,
+                    name=f"fleet-drain-{rep.name}").start()
+        self.n_target = n
+        self._update_gauges()
+        return delta
+
+    def _drain_victim(self, rep: Replica) -> None:
+        try:
+            self._terminate(rep, reason="scale_down", drain=True)
+        except Exception as e:
+            logger.exception("scale-down drain of %s failed: %s",
+                             rep.name, e)
+        with self._lock:
+            self._replicas.pop(rep.name, None)
+        self._update_gauges()
+
+    def _autoscale_tick(self) -> None:
+        backlog = self.broker.store.backlog(self.stream, self.group)
+        shed_total, p99_ms = self._sample_replicas()
+        shed_rate = max(0.0, shed_total - self._last_shed_total)
+        self._last_shed_total = shed_total
+        states = self.replica_states()
+        decision = self.autoscaler.decide(
+            states["total"], backlog, shed_rate=shed_rate,
+            p99_ms=p99_ms)
+        if decision:
+            self.scale_to(states["total"] + decision,
+                          reason="autoscale")
+
+    def _sample_replicas(self):
+        """(shed_total, worst p99 ms) scraped from replica
+        /metrics.json endpoints -- best-effort: an unreachable replica
+        contributes nothing (its health probe is the loud signal)."""
+        shed_total = 0.0
+        p99_ms: Optional[float] = None
+        with self._lock:
+            reps = [r for r in self._replicas.values()
+                    if r.address and r.state == "up"]
+        for rep in reps:
+            try:
+                with urllib.request.urlopen(
+                        rep.address + "/metrics.json",
+                        timeout=2.0) as resp:
+                    snap = json.load(resp)
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    ValueError) as e:
+                logger.debug("metrics scrape of %s failed: %s",
+                             rep.name, e)
+                continue
+            reg = snap.get("registry", {})
+            shed = reg.get("zoo_serving_shed_total")
+            if isinstance(shed, dict):
+                # snapshot family shape: {"type", "help",
+                # "values": {label-key: value}}
+                for v in (shed.get("values") or {}).values():
+                    shed_total += float(v or 0.0)
+            service = (snap.get("worker", {}).get("stages", {})
+                       .get("service", {}))
+            p99 = service.get("p99_s")  # Timer.summary's "_s" suffix
+            if p99 is not None:
+                p99 = float(p99) * 1000.0
+                p99_ms = p99 if p99_ms is None else max(p99_ms, p99)
+        return shed_total, p99_ms
+
+    # ----------------------------------------------------------- stats --
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            reps = {name: {"state": r.state, "healthy": r.healthy,
+                           "quiesced": r.quiesced, "pid": r.pid,
+                           "address": r.address,
+                           "restarts": r.restarts}
+                    for name, r in sorted(self._replicas.items())}
+        out = {
+            "target": self.n_target,
+            "replicas": reps,
+            "results_observed": self.results_observed,
+            "chaos_kills": self.chaos_kills,
+            "backlog": (self.broker.store.backlog(self.stream,
+                                                  self.group)
+                        if self.broker is not None else 0),
+        }
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.stats()
+        if self.min_healthy_during_restart is not None:
+            out["min_healthy_during_restart"] = (
+                self.min_healthy_during_restart)
+        return out

@@ -44,7 +44,8 @@ from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.obs.events import get_event_log, to_jsonable
 from analytics_zoo_tpu.obs.flight import get_inflight
 from analytics_zoo_tpu.obs.metrics import get_registry
-from analytics_zoo_tpu.serving.protocol import ERROR_KEY, error_status
+from analytics_zoo_tpu.serving.protocol import (
+    DRAINING_PREFIX, ERROR_KEY, error_status)
 from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
@@ -172,6 +173,11 @@ class HttpFrontend:
         self.timer = timer or Timer(mirror=_M_HTTP_STAGE)
         self._tls = certfile is not None
         self._started_at = time.time()
+        # drain state (ISSUE-9): a draining deployment refuses NEW
+        # predicts (503 + Retry-After) and fails its health check so
+        # the fleet router routes around it, while requests already
+        # in flight keep their mailboxes until answered
+        self._draining = False
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -302,6 +308,14 @@ class HttpFrontend:
             return code, payload
 
     def _handle_predict(self, req: Any):
+        if self._draining:
+            # structured refusal, same vocabulary as the wire errors:
+            # the caller (fleet router, or a well-behaved client) sees
+            # 503 + Retry-After and goes elsewhere
+            return 503, {"error": DRAINING_PREFIX,
+                         "detail": f"{DRAINING_PREFIX}: deployment "
+                                   "is draining for restart",
+                         "retry_after_s": self.retry_after_s}
         if not isinstance(req, dict):
             return 400, {"error": "body must be a JSON object"}
         if "instances" in req:
@@ -495,18 +509,28 @@ class HttpFrontend:
             logger.debug("debug endpoint: jax info unavailable: %s", e)
         return out
 
+    def set_draining(self) -> None:
+        """Flip the deployment into drain mode (one-way; the process
+        is on its way out): health goes 503 ``draining`` so the fleet
+        router stops routing here, /predict refuses new work."""
+        self._draining = True
+
     def health(self):
         """Liveness for ``GET /healthz``: 503 once a started worker's
         serving thread has died (a stopped or inline-run worker is not
-        a failure -- there is no thread to have died)."""
+        a failure -- there is no thread to have died), or while the
+        deployment is draining (in-flight work finishing; no new
+        traffic wanted)."""
         worker = self.worker
         thread = getattr(worker, "_thread", None)
         alive = thread is None or thread.is_alive()
+        status = (DRAINING_PREFIX if self._draining
+                  else "ok" if alive else "worker_dead")
         payload = {
-            "status": "ok" if alive else "worker_dead",
+            "status": status,
             "uptime_s": round(time.time() - self._started_at, 3),
         }
         if worker is not None:
             payload["served"] = worker.served
             payload["pipelined"] = worker.pipelined
-        return (200 if alive else 503), payload
+        return (200 if alive and not self._draining else 503), payload

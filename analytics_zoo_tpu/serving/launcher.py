@@ -16,10 +16,16 @@ Config schema (defaults in parentheses)::
       encrypted: false                   # load_encrypted_zoo
       secret: null                       #   its AES secret
     data:
-      queue: memory | dir | tcp://host:port (memory)
+      queue: memory | dir | tcp://host:port | redis://host:port (memory)
       path: null                         # dir-queue directory, or
-                                         # host:port when queue: tcp
+                                         # host:port when queue: tcp/redis
       maxlen: 10000
+      group: serving                     # redis: consumer-group name --
+      consumer: null                     #   N replicas sharing a group
+                                         #   shard the stream; consumer
+                                         #   names this member's claims
+      stream: serving_stream             # redis: request stream
+      result_stream: result_stream       # redis: worker default output
     params:
       batch_size: 8                      # base micro-batch cap (core_number)
       timeout_ms: 5.0                    # max linger per batch
@@ -42,7 +48,11 @@ Config schema (defaults in parentheses)::
 
 ``queue: tcp://...`` points every host's worker at one TcpQueueServer
 broker -- the cross-host data plane (the reference's Redis role): run N
-workers on N hosts against the same broker address.
+workers on N hosts against the same broker address. ``queue:
+redis://...`` is the FLEET data plane (ISSUE-9): the worker becomes
+one consumer-group member on a stream broker (redis_adapter stream
+mode) -- claims are acked on reply and a dead member's claims are
+reclaimed by survivors (serving/fleet.py drives N such deployments).
 
 With ``http.enabled`` the frontend OWNS the result stream (its router
 consumes every worker result, HttpFrontend's contract) -- direct queue
@@ -53,19 +63,28 @@ clients should deploy with ``http.enabled: false`` and read
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import signal
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.log import get_logger
 from analytics_zoo_tpu.inference.inference_model import InferenceModel
 from analytics_zoo_tpu.obs.events import emit as emit_event
+from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
 from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
 from analytics_zoo_tpu.serving.worker import ServingWorker
 
 logger = get_logger(__name__)
+
+_M_DRAIN = get_registry().histogram(
+    "zoo_serving_drain_duration_seconds",
+    "Graceful-drain wait: from drain_begin until the engine finished "
+    "its in-flight work (or the deadline expired)")
 
 
 class ServingApp:
@@ -87,6 +106,37 @@ class ServingApp:
     @property
     def address(self) -> Optional[str]:
         return self.frontend.address if self.frontend else None
+
+    def drain(self, deadline_ms: Optional[float] = None) -> bool:
+        """Graceful drain (ISSUE-9): refuse new work, finish what is
+        already in flight, within ``zoo.serving.drain.deadline_ms``.
+        The SIGTERM handler and each rolling-restart step run this
+        before ``stop()``; returns True when the engine fully drained
+        inside the budget. Safe to call once per app."""
+        if deadline_ms is None:
+            deadline_ms = float(get_config().get(
+                "zoo.serving.drain.deadline_ms", 10000.0))
+        emit_event("drain_begin", "serving", deadline_ms=deadline_ms)
+        t0 = time.monotonic()
+        # supervisor first: a draining worker's thread exits with its
+        # stop event unset, which must not read as a crash to restart
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        if self.frontend is not None:
+            # health goes 503 "draining" -> the fleet router (and any
+            # LB honoring /healthz) stops sending traffic here; new
+            # direct /predicts get a structured 503 + Retry-After
+            self.frontend.set_draining()
+        ok = self.worker.drain(deadline_s=deadline_ms / 1000.0)
+        waited = time.monotonic() - t0
+        _M_DRAIN.observe(waited)
+        emit_event("drain_complete", "serving", ok=ok,
+                   waited_s=round(waited, 3))
+        if not ok:
+            logger.warning(
+                "drain deadline (%.0f ms) expired with in-flight work "
+                "remaining; stop() will cut it loose", deadline_ms)
+        return ok
 
     def stop(self) -> None:
         # supervisor FIRST: it exists to restart a stopping worker,
@@ -179,12 +229,57 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     if data.get("queue") == "dir" and not data.get("path"):
         raise ValueError('data.queue "dir" needs data.path')
     queue_kind = data.get("queue")
+    # set only by the redis branch: the frontend drains its own reply
+    # stream instead of the worker's default output queue
+    frontend_out_q: Optional[OutputQueue] = None
     if queue_kind == "tcp":  # docstring form: queue: tcp + path: host:port
         if not data.get("path"):
             raise ValueError('data.queue "tcp" needs data.path '
                              '"host:port"')
         queue_kind = "tcp://" + str(data["path"])
-    if isinstance(queue_kind, str) and queue_kind.startswith("tcp://"):
+    if queue_kind == "redis":  # same form: queue: redis + path: host:port
+        if not data.get("path"):
+            raise ValueError('data.queue "redis" needs data.path '
+                             '"host:port"')
+        queue_kind = "redis://" + str(data["path"])
+    if isinstance(queue_kind, str) and queue_kind.startswith("redis://"):
+        # fleet data plane (ISSUE-9): this deployment is ONE consumer-
+        # group member on a shared stream broker (redis_adapter in
+        # stream mode) -- N replicas with the same data.group shard
+        # the stream; per-replica data.consumer names the PEL owner so
+        # a dead replica's claims are reclaimable
+        group = str(data.get("group", "serving"))
+        consumer = str(data.get("consumer") or f"replica-{os.getpid()}")
+        in_q = InputQueue(backend=queue_kind,
+                          name=str(data.get("stream", "serving_stream")),
+                          group=group, consumer=consumer)
+        # the worker's DEFAULT output is the broker's shared result
+        # stream (the controller's drain consumes it into the
+        # KEYS/HGETALL result table) -- direct stream clients get
+        # their answers there no matter which replica served them
+        out_q = OutputQueue(
+            backend=queue_kind,
+            name=str(data.get("result_stream", "result_stream")))
+        if http.get("enabled", True):
+            # this replica's frontend owns its own reply stream on
+            # the broker (its requests carry it as reply-to, the
+            # worker's _reply_backend routes results there) -- unlike
+            # the tcp branch, the frontend drains ONLY that stream,
+            # so direct stream traffic and HTTP traffic coexist on
+            # one fleet. The name derives from the STABLE consumer
+            # name, not a fresh uuid: a restarted replica re-attaches
+            # to the same stream and drains what its predecessor left
+            # behind -- a crash-looping replica must not mint an
+            # orphaned stream (never consumed, never trimmed) per
+            # cycle. Results for requests the dead frontend owned are
+            # drained-and-dropped as abandoned, which is their fate
+            # either way.
+            reply = f"reply_{consumer}"
+            in_q.reply_stream = reply
+            frontend_out_q = OutputQueue(
+                backend=queue_kind, name=reply,
+                group=f"{reply}_g", consumer=consumer)
+    elif isinstance(queue_kind, str) and queue_kind.startswith("tcp://"):
         in_q = InputQueue(backend=queue_kind)
         if http.get("enabled", True):
             # each deployment's frontend owns a UNIQUE result stream on
@@ -252,7 +347,9 @@ def launch(config: Dict[str, Any]) -> ServingApp:
                 # YAML's http.port wins when present
                 port = int(get_config().get("zoo.serving.http_port", 0))
             frontend = HttpFrontend(
-                in_q, out_q, host=http.get("host", "127.0.0.1"),
+                in_q,
+                out_q if frontend_out_q is None else frontend_out_q,
+                host=http.get("host", "127.0.0.1"),
                 port=port, worker=worker,
                 certfile=http.get("certfile"),
                 keyfile=http.get("keyfile")).start()
@@ -323,8 +420,18 @@ def main(argv=None) -> None:
         description="analytics_zoo_tpu serving launcher")
     ap.add_argument("-c", "--config", required=True,
                     help="path to the serving YAML config")
+    ap.add_argument("--ready-file",
+                    help="write {pid, address, started_at} JSON here "
+                         "once the deployment is serving (the fleet "
+                         "controller's readiness/address channel)")
     args = ap.parse_args(argv)
     app = launch_from_yaml(args.config)
+    if args.ready_file:
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"pid": os.getpid(), "address": app.address,
+                       "started_at": time.time()}, f)
+        os.replace(tmp, args.ready_file)  # atomic: never half-read
     stop = threading.Event()
 
     def handler(signum, frame):
@@ -341,6 +448,14 @@ def main(argv=None) -> None:
 
         install_flight_recorder(signals=True)
     stop.wait()
+    # SIGTERM used to stop immediately, abandoning in-flight requests
+    # (ISSUE-9 satellite): drain first -- stop pulling, answer what
+    # was already accepted -- under zoo.serving.drain.deadline_ms
+    # (0 restores the old cut-now behavior); rolling restarts lean on
+    # this exact seam
+    if float(get_config().get("zoo.serving.drain.deadline_ms",
+                              10000.0)) > 0:
+        app.drain()
     app.stop()
 
 

@@ -260,6 +260,12 @@ def main(argv=None) -> None:
     p_status = sub.add_parser("status")
     p_status.add_argument("-n", "--name")
     p_status.add_argument("--state-dir")
+    p_status.add_argument(
+        "--json", action="store_true",
+        help="machine-readable summary ({deployments, alive, total}) "
+             "and exit code 0 only when every queried deployment is "
+             "alive -- shell scripts and the fleet controller branch "
+             "on $? instead of parsing output")
     p_stop = sub.add_parser("stop")
     p_stop.add_argument("-n", "--name", required=True)
     p_stop.add_argument("--state-dir")
@@ -272,7 +278,18 @@ def main(argv=None) -> None:
                       state_dir=args.state_dir)
         print(json.dumps(state))
     elif args.cmd == "status":
-        print(json.dumps(status(args.name, state_dir=args.state_dir)))
+        records = status(args.name, state_dir=args.state_dir)
+        if args.json:
+            # the status --json contract (ISSUE-9 satellite): one JSON
+            # object + a liveness exit code, so callers never parse
+            # log-ish output. Exit 1 when anything queried is dead OR
+            # nothing is tracked ("the deployment you asked about is
+            # not running" must not exit 0).
+            alive = sum(1 for r in records if r.get("running"))
+            print(json.dumps({"deployments": records, "alive": alive,
+                              "total": len(records)}))
+            sys.exit(0 if records and alive == len(records) else 1)
+        print(json.dumps(records))
     elif args.cmd == "stop":
         ok = stop(args.name, state_dir=args.state_dir)
         print(json.dumps({"stopped": ok}))

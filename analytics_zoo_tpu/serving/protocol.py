@@ -51,6 +51,13 @@ WIRE_KEYS = (URI_KEY, REPLY_KEY, TRACE_KEY, DEADLINE_KEY)
 # ------------------------------------------------------ error prefixes --
 DEADLINE_PREFIX = "deadline_exceeded"
 CIRCUIT_PREFIX = "circuit_open"
+# fleet vocabulary (ISSUE-9): a draining replica refuses NEW work while
+# it finishes in-flight requests (rolling restart / SIGTERM drain), and
+# the front-tier router answers replica_unavailable only after its
+# one-retry-on-a-dead-replica budget is spent -- both are retryable,
+# so both map to 503 (every 503 carries Retry-After)
+DRAINING_PREFIX = "draining"
+REPLICA_PREFIX = "replica_unavailable"
 
 # prefix -> HTTP status the frontend answers with; prefixes absent
 # here fall through to 500 (generic server fault), which is exactly
@@ -58,6 +65,8 @@ CIRCUIT_PREFIX = "circuit_open"
 ERROR_PREFIXES = {
     DEADLINE_PREFIX: 504,
     CIRCUIT_PREFIX: 503,
+    DRAINING_PREFIX: 503,
+    REPLICA_PREFIX: 503,
 }
 
 

@@ -509,9 +509,28 @@ class TcpQueue:
         status, body = self._request(b"L", 0)
         return _struct.unpack(">I", body)[0] if status == "K" else 0
 
+    def for_stream(self, name: str) -> "TcpQueue":
+        """Handle on another stream of the same broker (the worker's
+        reply-to routing; brokered backends share this protocol)."""
+        return TcpQueue(f"tcp://{self._host}:{self._port}", name=name)
+
 
 def _make_backend(backend, path: Optional[str], maxlen: Optional[int],
-                  name: str = "serving_stream"):
+                  name: str = "serving_stream",
+                  group: Optional[str] = None,
+                  consumer: Optional[str] = None,
+                  autoack: bool = False):
+    if isinstance(backend, str) and backend.startswith("redis://"):
+        # fleet data plane: a consumer-group stream on the RESP2
+        # broker (redis_adapter) -- N workers passing the same group
+        # shard the stream, claims ride the pending list until the
+        # worker acks them on reply (lazy import: redis_adapter
+        # imports this module for the wire codec)
+        from analytics_zoo_tpu.serving.redis_adapter import (
+            RedisStreamQueue)
+
+        return RedisStreamQueue(backend, stream=name, group=group,
+                                consumer=consumer, autoack=autoack)
     if isinstance(backend, str) and backend.startswith("tcp://"):
         return TcpQueue(backend, name=name)
     if backend == "tcp":
@@ -535,9 +554,12 @@ class InputQueue:
                  name: str = "serving_stream",
                  reply_stream: Optional[str] = None,
                  shed_depth: Optional[int] = None,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 group: Optional[str] = None,
+                 consumer: Optional[str] = None):
         self._q = queue if queue is not None else _make_backend(
-            backend, path, maxlen, name=name)
+            backend, path, maxlen, name=name, group=group,
+            consumer=consumer)
         # when set, every request carries this reply-to stream so the
         # serving worker routes its result back to THIS producer's
         # result stream (brokered multi-frontend deployments)
@@ -629,9 +651,15 @@ class OutputQueue:
 
     def __init__(self, backend=None, path: Optional[str] = None,
                  maxlen: Optional[int] = None, queue=None,
-                 name: str = "result_stream"):
+                 name: str = "result_stream",
+                 group: Optional[str] = None,
+                 consumer: Optional[str] = None):
+        # result consumers are each their stream's sole owner, so a
+        # brokered group consumes destructively (autoack) -- the PEL's
+        # exactly-once machinery is the REQUEST stream's concern
         self._q = queue if queue is not None else _make_backend(
-            backend, path, maxlen, name=name)
+            backend, path, maxlen, name=name, group=group,
+            consumer=consumer, autoack=True)
 
     @property
     def queue(self):

@@ -10,16 +10,35 @@ get_field_and_data). This repo's data plane is its own queue design
 that accepts exactly the command surface those clients use and adapts
 it onto any InputQueue/OutputQueue backend pair.
 
-Served commands: XGROUP CREATE, XADD, INFO, KEYS, HGETALL, DEL, PING,
-CLIENT * (redis-py connection handshake), EXISTS. Everything else gets
-a clear -ERR.
+Two deployment modes:
+
+- **bridge** (the historical single-worker shape): construct with the
+  deployment's ``InputQueue``/``OutputQueue``; XADD decodes straight
+  into the input queue, a drain thread moves worker results into the
+  KEYS/HGETALL-visible result table.
+- **stream** (the fleet data plane, ISSUE-9): construct with
+  ``input_queue=None``; XADD appends to an in-process
+  :class:`StreamStore` and N replica worker processes shard the
+  stream through **consumer groups** (XREADGROUP/XACK -- the exact
+  fan-out the reference got from FlinkRedisSource's consumer groups,
+  ref: serving/engine/FlinkRedisSource.scala). A pending-entries list
+  per group remembers which consumer claimed what; entries idle past
+  ``zoo.serving.fleet.reclaim_idle_ms`` are **reclaimable**
+  (XAUTOCLAIM) so a SIGKILLed replica's claimed-but-unanswered
+  requests are re-served by a survivor instead of being orphaned
+  forever.
+
+Served commands: XGROUP CREATE, XADD, XREADGROUP, XACK, XPENDING,
+XAUTOCLAIM, XLEN, INFO, KEYS, HGETALL, DEL, PING, CLIENT * (redis-py
+connection handshake), EXISTS. Everything else gets a clear -ERR.
 
 Wire-format notes:
 - XADD ``data`` fields hold a base64 Arrow RecordBatch stream; dense
   tensors arrive as the reference's 4-row struct (indiceData /
   indiceShape / data / shape), strings as base64 image bytes. Sparse
   tensors are rejected with a clear error (this serving stack has no
-  sparse input path).
+  sparse input path). XADD ``blob`` fields carry a raw AZT1 wire blob
+  (the fleet's replica-to-replica format -- no Arrow round trip).
 - Results are stored as ``cluster-serving_<stream>:<uri>`` hashes with
   a ``value`` field holding the JSON-encoded output tensor(s) --
   nested lists, the shape the reference's HTTP route exposes.
@@ -28,6 +47,7 @@ Wire-format notes:
 from __future__ import annotations
 
 import base64
+import collections
 import fnmatch
 import io
 import json
@@ -35,16 +55,34 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.log import get_logger
 from analytics_zoo_tpu.obs.events import emit as emit_event
+from analytics_zoo_tpu.obs.metrics import get_registry
 
 logger = get_logger(__name__)
 
+_M_RECLAIMED = get_registry().counter(
+    "zoo_serving_stream_reclaimed_total",
+    "Pending stream entries reclaimed from dead/stalled consumers "
+    "(XAUTOCLAIM with the fleet idle threshold)")
+
 RESULT_PREFIX = "cluster-serving_"
+# field name for raw AZT1 blobs riding a stream entry (the fleet data
+# plane); reference clients use "data" (base64 Arrow) instead
+BLOB_FIELD = b"blob"
+
+# poison-request bound (the fleet's version of the RequestLedger's
+# "one error reply after two crashes"): an entry still un-acked after
+# this many deliveries has, with high likelihood, KILLED every replica
+# that claimed it -- reclaiming it again would crash-loop the whole
+# fleet, so the broker dead-letters it with one structured error
+# result instead
+POISON_MAX_DELIVERIES = 3
 
 # result-drain reconnect backoff (capped exponential): the drain loop
 # must survive a broker/queue-backend outage, not die on the first
@@ -104,6 +142,282 @@ def encode_result_value(tensors: Dict[str, np.ndarray]) -> str:
     if list(clean) == ["output"]:
         return json.dumps(clean["output"])
     return json.dumps(clean)
+
+
+# ------------------------------------------------------------ streams --
+class _Pending:
+    """One pending-entries-list record: who claimed the entry, when,
+    and how many times it has been (re)delivered."""
+
+    __slots__ = ("consumer", "delivered_at", "count")
+
+    def __init__(self, consumer: str, delivered_at: float,
+                 count: int = 1):
+        self.consumer = consumer
+        self.delivered_at = delivered_at
+        self.count = count
+
+
+class StreamStore:
+    """In-memory Redis-stream engine with consumer groups.
+
+    The fleet's shared input stream lives here (hosted by the
+    controller's :class:`RedisFrontend` in stream mode). Semantics
+    follow Redis where it matters for correctness:
+
+    - XADD appends ``(id, fields)``; ids are ``<seq>-0`` with a
+      per-stream monotonic ``seq`` (same total order as Redis ids,
+      simpler to mint without a clock);
+    - XREADGROUP ``>`` delivers entries past the group's
+      last-delivered cursor and records each in the group's PEL
+      (pending entries list) under the claiming consumer;
+    - XACK removes from the PEL -- only then may an entry be trimmed;
+    - XAUTOCLAIM reassigns PEL entries idle beyond a threshold to the
+      calling consumer (delivery count bumped): the recovery seam for
+      entries claimed by a consumer that died before answering.
+
+    Unlike Redis, fully-acknowledged entries are trimmed eagerly (every
+    group delivered AND acked them), so ``xlen`` reads as "backlog +
+    in-flight" -- exactly the depth admission control and the adaptive
+    batcher want -- and memory stays bounded by outstanding work, not
+    stream history. ``maxlen`` bounds un-acked backlog; a full stream
+    refuses XADD (the queue-full backpressure signal upstream maps to
+    503 + Retry-After)."""
+
+    def __init__(self, maxlen: Optional[int] = 10000):
+        self._lock = threading.Lock()
+        self._maxlen = maxlen
+        # stream -> OrderedDict[id, (seq, fields)] (insertion = seq order)
+        self._entries: Dict[str, "collections.OrderedDict"] = {}
+        self._seq: Dict[str, int] = {}
+        # (stream, group) -> {"last": seq, "pel": {id: _Pending}}
+        self._groups: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # fully-acked entries PINNED behind an un-acked head (trim only
+        # pops head runs): excluded from the outstanding count so one
+        # stuck request cannot inflate xlen into -OOM backpressure
+        self._done: Dict[str, set] = {}
+
+    # ------------------------------------------------------- producers --
+    def xadd(self, stream: str,
+             fields: Dict[bytes, bytes]) -> Optional[str]:
+        """Append; returns the new id, or None when the stream is at
+        ``maxlen`` un-acked entries (backpressure)."""
+        with self._lock:
+            entries = self._entries.setdefault(
+                stream, collections.OrderedDict())
+            outstanding = len(entries) - len(self._done.get(stream, ()))
+            if self._maxlen is not None and outstanding >= self._maxlen:
+                return None
+            seq = self._seq.get(stream, 0) + 1
+            self._seq[stream] = seq
+            entry_id = f"{seq}-0"
+            entries[entry_id] = (seq, dict(fields))
+            return entry_id
+
+    # ------------------------------------------------------- consumers --
+    def create_group(self, stream: str, group: str,
+                     start: str = "0") -> bool:
+        """Returns False when the group already exists (BUSYGROUP).
+        ``start="$"`` delivers only entries added after creation;
+        ``"0"`` (the fleet default) delivers from the beginning --
+        requests enqueued before the first replica came up must not
+        be invisible to the whole fleet."""
+        with self._lock:
+            key = (stream, group)
+            if key in self._groups:
+                return False
+            last = self._seq.get(stream, 0) if start == "$" else 0
+            self._groups[key] = {"last": last, "pel": {}}
+            self._entries.setdefault(stream, collections.OrderedDict())
+            return True
+
+    def xreadgroup(self, stream: str, group: str, consumer: str,
+                   count: int) -> List[Tuple[str, Dict[bytes, bytes]]]:
+        with self._lock:
+            g = self._groups.get((stream, group))
+            if g is None:
+                raise KeyError(
+                    f"NOGROUP no consumer group {group!r} on stream "
+                    f"{stream!r} (XGROUP CREATE it first)")
+            out = []
+            now = time.monotonic()
+            for entry_id, (seq, fields) in self._entries.get(
+                    stream, {}).items():
+                if seq <= g["last"]:
+                    continue
+                g["last"] = seq
+                g["pel"][entry_id] = _Pending(consumer, now)
+                out.append((entry_id, dict(fields)))
+                if len(out) >= count:
+                    break
+            return out
+
+    def xack(self, stream: str, group: str, ids: List[str]) -> int:
+        with self._lock:
+            g = self._groups.get((stream, group))
+            if g is None:
+                return 0
+            n = 0
+            for entry_id in ids:
+                if g["pel"].pop(entry_id, None) is not None:
+                    n += 1
+                    self._mark_done_locked(stream, entry_id)
+            if n:
+                self._trim_locked(stream)
+            return n
+
+    def _entry_done_locked(self, stream: str, entry_id: str,
+                           seq: int) -> bool:
+        groups = [g for (s, _), g in self._groups.items()
+                  if s == stream]
+        return bool(groups) and all(
+            seq <= g["last"] and entry_id not in g["pel"]
+            for g in groups)
+
+    def _mark_done_locked(self, stream: str, entry_id: str) -> None:
+        rec = self._entries.get(stream, {}).get(entry_id)
+        if rec is not None and self._entry_done_locked(stream, entry_id,
+                                                       rec[0]):
+            self._done.setdefault(stream, set()).add(entry_id)
+
+    def _trim_locked(self, stream: str) -> None:
+        """Pop head runs of entries every group has both delivered and
+        acked -- the eager-trim policy that keeps outstanding == real
+        work. Entries acked behind an un-acked head stay stored (the
+        dict is ordered) but sit in ``_done`` so xlen/backpressure
+        ignore them -- one stuck request must not read as a full
+        stream."""
+        entries = self._entries.get(stream)
+        if not entries:
+            return
+        done = self._done.get(stream, set())
+        while entries:
+            entry_id, (seq, _) = next(iter(entries.items()))
+            if not (entry_id in done
+                    or self._entry_done_locked(stream, entry_id, seq)):
+                return
+            entries.popitem(last=False)
+            done.discard(entry_id)
+
+    def xautoclaim(self, stream: str, group: str, consumer: str,
+                   min_idle_ms: float, count: int
+                   ) -> List[Tuple[str, Dict[bytes, bytes]]]:
+        """Reassign up to ``count`` PEL entries idle >= ``min_idle_ms``
+        to ``consumer`` (any prior owner, itself included -- a
+        restarted same-name consumer recovers its own orphans) and
+        return them for re-delivery."""
+        with self._lock:
+            g = self._groups.get((stream, group))
+            if g is None:
+                return []
+            entries = self._entries.get(stream, {})
+            now = time.monotonic()
+            out = []
+            # sorted by seq so re-delivery keeps arrival order
+            for entry_id in sorted(g["pel"],
+                                   key=lambda i: int(i.split("-")[0])):
+                p = g["pel"][entry_id]
+                if (now - p.delivered_at) * 1000.0 < min_idle_ms:
+                    continue
+                if p.count >= POISON_MAX_DELIVERIES:
+                    # presumed poisonous (killed every claimant so
+                    # far): left for evict_poisoned's dead-letter
+                    # path, never re-served
+                    continue
+                rec = entries.get(entry_id)
+                if rec is None:  # trimmed under our feet: drop the
+                    del g["pel"][entry_id]  # dangling PEL record
+                    continue
+                p.consumer = consumer
+                p.delivered_at = now
+                p.count += 1
+                out.append((entry_id, dict(rec[1])))
+                if len(out) >= count:
+                    break
+            return out
+
+    def evict_poisoned(self, stream: str, group: str,
+                       min_idle_ms: float,
+                       max_deliveries: int = POISON_MAX_DELIVERIES
+                       ) -> List[Tuple[str, Dict[bytes, bytes]]]:
+        """Remove-and-return idle PEL entries already delivered
+        ``max_deliveries`` times: each claimant died without acking,
+        so the entry is presumed to KILL its server and must not be
+        reclaimed again (the caller owes each one a structured error
+        reply -- the fleet's dead-letter path)."""
+        with self._lock:
+            g = self._groups.get((stream, group))
+            if g is None:
+                return []
+            entries = self._entries.get(stream, {})
+            now = time.monotonic()
+            out = []
+            for entry_id in sorted(g["pel"],
+                                   key=lambda i: int(i.split("-")[0])):
+                p = g["pel"][entry_id]
+                if (p.count < max_deliveries
+                        or (now - p.delivered_at) * 1000.0
+                        < min_idle_ms):
+                    continue
+                del g["pel"][entry_id]
+                rec = entries.get(entry_id)
+                if rec is None:
+                    continue
+                out.append((entry_id, dict(rec[1])))
+                self._mark_done_locked(stream, entry_id)
+            if out:
+                self._trim_locked(stream)
+            return out
+
+    # --------------------------------------------------- introspection --
+    def xlen(self, stream: str) -> int:
+        with self._lock:
+            return (len(self._entries.get(stream, ()))
+                    - len(self._done.get(stream, ())))
+
+    def backlog(self, stream: str, group: str) -> int:
+        """Entries not yet delivered to ``group`` -- the autoscaler's
+        queue-depth signal (in-flight claims excluded)."""
+        with self._lock:
+            g = self._groups.get((stream, group))
+            entries = self._entries.get(stream)
+            if not entries:
+                return 0
+            if g is None:
+                return len(entries)
+            last = g["last"]
+            return sum(1 for (seq, _) in entries.values() if seq > last)
+
+    def xpending_summary(self, stream: str, group: str
+                         ) -> Tuple[int, Optional[str], Optional[str],
+                                    List[Tuple[str, int]]]:
+        with self._lock:
+            g = self._groups.get((stream, group))
+            if g is None or not g["pel"]:
+                return 0, None, None, []
+            ids = sorted(g["pel"], key=lambda i: int(i.split("-")[0]))
+            per: Dict[str, int] = {}
+            for p in g["pel"].values():
+                per[p.consumer] = per.get(p.consumer, 0) + 1
+            return (len(ids), ids[0], ids[-1], sorted(per.items()))
+
+    def xpending_range(self, stream: str, group: str, count: int
+                       ) -> List[Tuple[str, str, int, int]]:
+        """[(id, consumer, idle_ms, delivery_count)] oldest-first."""
+        with self._lock:
+            g = self._groups.get((stream, group))
+            if g is None:
+                return []
+            now = time.monotonic()
+            out = []
+            for entry_id in sorted(g["pel"],
+                                   key=lambda i: int(i.split("-")[0])):
+                p = g["pel"][entry_id]
+                out.append((entry_id, p.consumer,
+                            int((now - p.delivered_at) * 1000), p.count))
+                if len(out) >= count:
+                    break
+            return out
 
 
 # -------------------------------------------------------------- resp --
@@ -195,21 +509,80 @@ class _RespConnection:
         for it in items:
             self.bulk(it)
 
+    def resp(self, obj) -> None:
+        """Nested RESP2 reply: ints -> :n, None -> nil bulk, lists ->
+        arrays (recursive -- XREADGROUP/XAUTOCLAIM reply shapes),
+        everything else a bulk string."""
+        parts: List[bytes] = []
+        self._resp_parts(obj, parts)
+        self.sock.sendall(b"".join(parts))
+
+    def _resp_parts(self, obj, parts: List[bytes]) -> None:
+        if obj is None:
+            parts.append(b"$-1\r\n")
+        elif isinstance(obj, bool):  # before int: bool is an int
+            parts.append(b":%d\r\n" % int(obj))
+        elif isinstance(obj, int):
+            parts.append(b":%d\r\n" % obj)
+        elif isinstance(obj, (list, tuple)):
+            parts.append(b"*%d\r\n" % len(obj))
+            for it in obj:
+                self._resp_parts(it, parts)
+        else:
+            data = obj.encode() if isinstance(obj, str) else bytes(obj)
+            parts.append(b"$%d\r\n%s\r\n" % (len(data), data))
+
 
 class RedisFrontend:
-    """RESP2 server bridging reference serving clients onto this
-    stack's queue backends. Start with ``serve()``; stop with
-    ``stop()``. A drain thread moves worker results from the output
-    queue into the KEYS/HGETALL-visible result table."""
+    """RESP2 server over this stack's serving data plane. Start with
+    ``serve()``; stop with ``stop()``.
 
-    def __init__(self, input_queue, output_queue,
+    **Bridge mode** (``input_queue`` given, the historical shape):
+    XADD decodes straight into the input queue; a drain thread moves
+    worker results from ``output_queue`` into the KEYS/HGETALL-visible
+    result table.
+
+    **Stream mode** (``input_queue=None``, the fleet broker): XADD
+    appends to an in-process :class:`StreamStore`; replica workers
+    shard the stream via XREADGROUP consumer groups
+    (:class:`RedisStreamQueue` is the client backend) and push result
+    blobs to ``result_stream`` on the same store, which the drain
+    thread consumes into the result table. ``result_callback(uri,
+    tensors)`` observes every consumed result (the fleet soak's
+    exactly-once ledger)."""
+
+    def __init__(self, input_queue=None, output_queue=None,
                  host: str = "127.0.0.1", port: int = 6379,
-                 name: str = "serving_stream"):
+                 name: str = "serving_stream",
+                 result_stream: str = "result_stream",
+                 store: Optional[StreamStore] = None,
+                 maxlen: Optional[int] = 10000,
+                 result_callback: Optional[Callable] = None):
+        if (input_queue is None) != (output_queue is None):
+            raise ValueError("pass both queues (bridge mode) or "
+                             "neither (stream mode)")
         self._in = input_queue
         self._out = output_queue
         self.name = name
+        self.result_stream = result_stream
+        self.stream_mode = input_queue is None
+        self.store = store or StreamStore(maxlen=maxlen)
+        self.result_callback = result_callback
         self._results: Dict[str, Dict[str, str]] = {}
-        self._groups: set = set()
+        # fleet-level exactly-once (stream mode): the PEL's reclaim is
+        # at-least-once by construction -- a replica SIGKILLed between
+        # reply-push and XACK gets its entry re-served -- so stream
+        # mode keeps a delivery LEDGER (the RequestLedger idea at
+        # fleet level): a second result for an already-answered uri is
+        # a re-serve, suppressed and counted, never delivered twice.
+        # The ledger is its OWN bounded structure, not the result
+        # table: clients DEL table entries after reading (reopening
+        # the window) and may never DEL at all (unbounded table is
+        # reference behavior; an unbounded ledger would not be).
+        self.duplicates_suppressed = 0
+        self._answered: "collections.OrderedDict[str, bool]" = (
+            collections.OrderedDict())
+        self._answered_cap = 65536
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._seq = 0
@@ -273,17 +646,77 @@ class RedisFrontend:
         for t in self._threads:
             t.join(timeout=2.0)
 
+    def _store_result(self, uri: str, tensors) -> None:
+        key = f"{RESULT_PREFIX}{self.name}:{uri}"
+        with self._lock:
+            if self.stream_mode:
+                if uri in self._answered:
+                    # delivery-ledger hit: this request was already
+                    # answered (the at-least-once redelivery window --
+                    # see duplicates_suppressed above). Checked even
+                    # after a client DELs the table entry.
+                    self.duplicates_suppressed += 1
+                    logger.warning(
+                        "suppressed duplicate result for %s "
+                        "(re-served after a reclaim race)", uri)
+                    return
+                self._answered[uri] = True
+                while len(self._answered) > self._answered_cap:
+                    # bound: the oldest answers age out of dedup
+                    # coverage (a re-serve arrives within seconds of
+                    # its original -- reclaim_idle_ms scale -- so the
+                    # cap only needs to outlive that window)
+                    self._answered.popitem(last=False)
+            self._results[key] = {
+                "value": encode_result_value(tensors)}
+        if self.result_callback is not None:
+            try:
+                self.result_callback(uri, tensors)
+            except Exception as e:  # an observer bug must not kill
+                logger.exception(   # the result path
+                    "redis adapter result callback failed: %s", e)
+
+    def _drain_results_once(self) -> int:
+        """One drain pass; returns results moved into the table."""
+        if not self.stream_mode:
+            moved = 0
+            for uri, tensors in self._out.dequeue_all():
+                self._store_result(uri, tensors)
+                moved += 1
+            return moved
+        # stream mode: the result stream lives in OUR store -- consume
+        # it directly (group "router", acked immediately: the table is
+        # the durable side, and a controller restart restarts the
+        # whole broker anyway)
+        from analytics_zoo_tpu.serving.queues import _decode
+
+        self.store.create_group(self.result_stream, "router")
+        moved = 0
+        while True:
+            entries = self.store.xreadgroup(
+                self.result_stream, "router", "controller", 256)
+            if not entries:
+                return moved
+            self.store.xack(self.result_stream, "router",
+                            [eid for eid, _ in entries])
+            for _, fields in entries:
+                blob = fields.get(BLOB_FIELD)
+                if blob is None:
+                    continue
+                try:
+                    uri, tensors = _decode(blob)
+                except Exception as e:  # one bad blob, not the drain
+                    logger.exception(
+                        "redis adapter: undecodable result blob: %s", e)
+                    continue
+                self._store_result(uri, tensors)
+                moved += 1
+
     def _drain_loop(self) -> None:
         backoff = _RECONNECT_BASE_S
         while not self._stop.is_set():
             try:
-                moved = 0
-                for uri, tensors in self._out.dequeue_all():
-                    key = f"{RESULT_PREFIX}{self.name}:{uri}"
-                    with self._lock:
-                        self._results[key] = {
-                            "value": encode_result_value(tensors)}
-                    moved += 1
+                moved = self._drain_results_once()
                 backoff = _RECONNECT_BASE_S  # healthy pass: reset
                 if not moved:
                     time.sleep(0.005)
@@ -317,6 +750,18 @@ class RedisFrontend:
             self._xgroup(conn, cmd)
         elif op == "XADD":
             self._xadd(conn, cmd)
+        elif op == "XREADGROUP":
+            self._xreadgroup(conn, cmd)
+        elif op == "XACK":
+            n = self.store.xack(cmd[1].decode(), cmd[2].decode(),
+                                [c.decode() for c in cmd[3:]])
+            conn.integer(n)
+        elif op == "XLEN":
+            conn.integer(self.store.xlen(cmd[1].decode()))
+        elif op == "XPENDING":
+            self._xpending(conn, cmd)
+        elif op == "XAUTOCLAIM":
+            self._xautoclaim(conn, cmd)
         elif op == "INFO":
             # the reference client's back-pressure check reads
             # used_memory vs maxmemory; report a tiny fraction so it
@@ -358,20 +803,102 @@ class RedisFrontend:
         if sub != "CREATE" or len(cmd) < 4:
             conn.error("only XGROUP CREATE is supported")
             return
-        key = (cmd[2].decode(), cmd[3].decode())
-        # membership check + add under the lock: two clients racing on
-        # XGROUP CREATE must see exactly one +OK and one BUSYGROUP
-        # (an unlocked check-then-add could answer +OK to both)
-        with self._lock:
-            exists = key in self._groups
-            if not exists:
-                self._groups.add(key)
-        if exists:
+        start = cmd[4].decode() if len(cmd) > 4 else "$"
+        # StreamStore.create_group is atomic: two clients racing on
+        # XGROUP CREATE see exactly one +OK and one BUSYGROUP
+        if not self.store.create_group(cmd[2].decode(),
+                                       cmd[3].decode(), start=start):
             # match real redis so client retry logic behaves
             self.sock_err(conn, "BUSYGROUP Consumer Group name "
                                 "already exists")
             return
         conn.ok()
+
+    def _xreadgroup(self, conn: _RespConnection,
+                    cmd: List[bytes]) -> None:
+        # XREADGROUP GROUP <g> <consumer> [COUNT n] STREAMS <s> >
+        # (no BLOCK support -- clients poll; the adaptive batcher's
+        # pull loop is already a poll)
+        args = [c.decode() for c in cmd[1:]]
+        upper = [a.upper() for a in args]
+        try:
+            gi = upper.index("GROUP")
+            group, consumer = args[gi + 1], args[gi + 2]
+            count = (int(args[upper.index("COUNT") + 1])
+                     if "COUNT" in upper else 1)
+            stream = args[upper.index("STREAMS") + 1]
+        except (ValueError, IndexError):
+            conn.error("XREADGROUP needs GROUP <g> <consumer> "
+                       "[COUNT n] STREAMS <stream> >")
+            return
+        try:
+            entries = self.store.xreadgroup(stream, group, consumer,
+                                            count)
+        except KeyError as e:
+            self.sock_err(conn, str(e).strip("'\""))
+            return
+        if not entries:
+            conn.resp(None)
+            return
+        conn.resp([[stream, [
+            [eid, [x for kv in fields.items() for x in kv]]
+            for eid, fields in entries]]])
+
+    def _xpending(self, conn: _RespConnection,
+                  cmd: List[bytes]) -> None:
+        stream, group = cmd[1].decode(), cmd[2].decode()
+        if len(cmd) >= 6:  # XPENDING s g - + count (detail form)
+            count = int(cmd[5])
+            conn.resp([[eid, consumer, idle_ms, n] for
+                       eid, consumer, idle_ms, n in
+                       self.store.xpending_range(stream, group, count)])
+            return
+        total, lo, hi, per = self.store.xpending_summary(stream, group)
+        conn.resp([total, lo, hi,
+                   [[c, str(n)] for c, n in per] if per else None])
+
+    def _xautoclaim(self, conn: _RespConnection,
+                    cmd: List[bytes]) -> None:
+        # XAUTOCLAIM <s> <g> <consumer> <min-idle-ms> <start> [COUNT n]
+        if len(cmd) < 6:
+            conn.error("XAUTOCLAIM needs stream, group, consumer, "
+                       "min-idle-time and start")
+            return
+        args = [c.decode() for c in cmd[1:]]
+        count = 100
+        if len(args) >= 7 and args[5].upper() == "COUNT":
+            count = int(args[6])
+        if self.stream_mode:
+            # dead-letter seam: entries whose every delivery ended in
+            # an un-acked death are answered with ONE structured error
+            # (the RequestLedger contract at fleet level) instead of
+            # being reclaimed into another crash
+            self._dead_letter(args[0], args[1], float(args[3]))
+        entries = self.store.xautoclaim(args[0], args[1], args[2],
+                                        float(args[3]), count)
+        conn.resp(["0-0", [
+            [eid, [x for kv in fields.items() for x in kv]]
+            for eid, fields in entries], []])
+
+    def _dead_letter(self, stream: str, group: str,
+                     min_idle_ms: float) -> None:
+        from analytics_zoo_tpu.serving.protocol import ERROR_KEY
+        from analytics_zoo_tpu.serving.queues import _decode_request
+
+        for _, fields in self.store.evict_poisoned(stream, group,
+                                                   min_idle_ms):
+            blob = fields.get(BLOB_FIELD)
+            if blob is None:
+                continue
+            try:
+                uri = _decode_request(blob)[0]
+            except Exception:
+                continue  # undecodable: nothing to answer
+            msg = (f"request failed: {POISON_MAX_DELIVERIES} replicas "
+                   "died while serving it (dead-lettered)")
+            emit_event("serving_error", "serving", uri=uri, error=msg)
+            logger.error("dead-lettering %s: %s", uri, msg)
+            self._store_result(uri, {ERROR_KEY: np.asarray(msg)})
 
     @staticmethod
     def sock_err(conn: _RespConnection, msg: str) -> None:
@@ -382,10 +909,12 @@ class RedisFrontend:
             conn.error("XADD needs stream, id and field/value pairs")
             return
         stream = cmd[1].decode()
-        if stream != self.name:
-            # results are keyed under the CONFIGURED stream; silently
-            # accepting another name would strand the client polling
-            # result keys that never appear -- fail fast instead
+        if not self.stream_mode and stream != self.name:
+            # bridge mode: results are keyed under the CONFIGURED
+            # stream; silently accepting another name would strand the
+            # client polling result keys that never appear -- fail
+            # fast instead. (Stream mode accepts any stream: reply /
+            # result streams are part of the fleet plumbing.)
             conn.error(f"this adapter serves stream {self.name!r}, "
                        f"not {stream!r} (set the client's name= to "
                        "match the deployment's redis.stream)")
@@ -393,6 +922,16 @@ class RedisFrontend:
         fields: Dict[bytes, bytes] = {}
         for i in range(3, len(cmd) - 1, 2):
             fields[cmd[i]] = cmd[i + 1]
+        if self.stream_mode and BLOB_FIELD in fields:
+            # fleet fast path: the entry already IS an AZT1 wire blob
+            if self.store.xadd(stream, fields) is None:
+                conn.error("OOM input queue full")
+                return
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+            conn.bulk(f"{int(time.time() * 1000)}-{seq}")
+            return
         # sequence allocation stays inside the lock: concurrent
         # uri-less XADDs must never share a generated uri (results are
         # keyed by uri -- a collision overwrites someone's prediction)
@@ -405,7 +944,312 @@ class RedisFrontend:
             conn.error("XADD entry carries no 'data' field")
             return
         tensors = decode_arrow_payload(payload)
-        if not self._in.enqueue(uri, **tensors):
+        if self.stream_mode:
+            # reference client on the fleet broker: re-encode as the
+            # one wire format replicas decode (uri rides the blob)
+            from analytics_zoo_tpu.serving.queues import _encode
+
+            blob = _encode(uri, tensors)
+            if self.store.xadd(stream, {BLOB_FIELD: blob}) is None:
+                conn.error("OOM input queue full")
+                return
+            with self._lock:
+                # a RE-SUBMITTED uri is a new request: it re-opens
+                # the delivery ledger (fleet blob producers mint
+                # unique ids; uri reuse is a reference-client idiom)
+                self._answered.pop(uri, None)
+        elif not self._in.enqueue(uri, **tensors):
             conn.error("OOM input queue full")  # redis-speak for full
             return
         conn.bulk(f"{int(time.time() * 1000)}-{seq}")
+
+
+# ------------------------------------------------------ stream client --
+class RedisReplyError(Exception):
+    """The server answered ``-ERR ...`` (application-level refusal,
+    e.g. a full stream); connection-level failures stay OSError."""
+
+
+class RedisStreamQueue:
+    """Queue backend over the adapter's RESP2 stream surface.
+
+    The fleet's consumer-group client (ISSUE-9): N replica processes
+    construct this with the same ``group`` and distinct ``consumer``
+    names, and the broker shards the request stream across them --
+    each entry is delivered to exactly one consumer, tracked in the
+    group's pending list until that consumer ACKs it (the worker acks
+    when it pushes the reply, so a SIGKILLed replica's claimed-but-
+    unanswered entries stay pending). Every claim pass first runs
+    XAUTOCLAIM with ``zoo.serving.fleet.reclaim_idle_ms``: entries a
+    dead consumer left idle past the threshold are reclaimed and
+    re-served by the caller -- without this, a crashed group member
+    orphans its pending messages forever.
+
+    Without ``group`` the instance is a producer / destructive
+    consumer (``autoack`` forced): reply/result streams with a single
+    owner. Implements the queue-backend protocol ``put`` / ``get`` /
+    ``get_many`` / ``__len__`` plus the fleet seams ``ack_uris``
+    (called by the worker on reply), ``pause``/``resume`` (the drain
+    seam: a paused queue claims nothing new), and ``for_stream`` (the
+    worker's reply-to routing)."""
+
+    def __init__(self, address: str, stream: str = "serving_stream",
+                 group: Optional[str] = None,
+                 consumer: Optional[str] = None,
+                 autoack: bool = False,
+                 reclaim_idle_ms: Optional[float] = None):
+        addr = address
+        for prefix in ("redis://", "tcp://"):
+            if addr.startswith(prefix):
+                addr = addr[len(prefix):]
+        host, port = addr.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self.stream = stream
+        self.group = group
+        self.consumer = consumer or f"consumer-{id(self):x}"
+        self.autoack = bool(autoack) or group is None
+        self.reclaim_idle_ms = float(
+            get_config().get("zoo.serving.fleet.reclaim_idle_ms", 5000.0)
+            if reclaim_idle_ms is None else reclaim_idle_ms)
+        self._lock = threading.Lock()     # socket (one in-flight cmd)
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._plock = threading.Lock()    # uri -> [entry ids] claims
+        self._pending: "collections.OrderedDict[str, List[str]]" = (
+            collections.OrderedDict())
+        self._group_ready = False
+        self._paused = False
+        # reclaim pacing: XAUTOCLAIM scans the whole PEL under the
+        # store's lock, and idle workers poll every few ms -- running
+        # it on every claim pass would double broker traffic for a
+        # signal that only changes at reclaim_idle_ms granularity.
+        # Half the threshold keeps worst-case recovery latency at
+        # ~1.5x the threshold while the steady state pays one
+        # XREADGROUP per poll.
+        self._next_reclaim = 0.0
+
+    # ------------------------------------------------------- transport --
+    def _connect(self) -> None:
+        # only ever called from _cmd, which already holds self._lock
+        self._sock = socket.create_connection(  # zoolint: disable=lock-guard
+            (self._host, self._port), timeout=30.0)
+        self._buf = b""
+
+    def _cmd(self, *parts):
+        """One RESP2 command round trip (under the socket lock, one
+        reconnect retry -- the TcpQueue convention)."""
+        payload = [b"*%d\r\n" % len(parts)]
+        for p in parts:
+            b = (p.encode() if isinstance(p, str)
+                 else str(p).encode() if isinstance(p, int)
+                 else bytes(p))
+            payload.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        data = b"".join(payload)
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    self._sock.sendall(data)
+                    return self._reply()
+                except OSError:
+                    try:
+                        if self._sock is not None:
+                            self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    if attempt:
+                        raise
+        raise OSError("unreachable")
+
+    def _fill(self) -> None:
+        chunk = self._sock.recv(65536)
+        if not chunk:
+            raise OSError("connection closed")
+        self._buf += chunk
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            self._fill()
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_nbytes(self, n: int) -> bytes:
+        while len(self._buf) < n + 2:
+            self._fill()
+        data, self._buf = self._buf[:n], self._buf[n + 2:]
+        return data
+
+    def _reply(self):
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RedisReplyError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            return None if n < 0 else self._read_nbytes(n)
+        if kind == b"*":
+            n = int(rest)
+            return None if n < 0 else [self._reply() for _ in range(n)]
+        raise OSError(f"bad RESP reply type {line!r}")
+
+    # --------------------------------------------------------- produce --
+    def put(self, item: bytes) -> bool:
+        try:
+            self._cmd("XADD", self.stream, "*", "blob", item)
+            return True
+        except RedisReplyError as e:
+            if "OOM" in str(e):
+                return False  # stream full: the backpressure signal
+            raise
+
+    def for_stream(self, name: str) -> "RedisStreamQueue":
+        """Producer handle for another stream on the same broker (the
+        worker's reply-to routing)."""
+        return RedisStreamQueue(f"{self._host}:{self._port}",
+                                stream=name)
+
+    # --------------------------------------------------------- consume --
+    def pause(self) -> None:
+        """Drain seam: stop claiming new entries (in-flight claims
+        still get acked); ``resume`` re-arms."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
+    def _ensure_group(self) -> None:
+        if self._group_ready:
+            return
+        try:
+            # from "0": entries enqueued before the first consumer
+            # came up must not be invisible to the whole group
+            self._cmd("XGROUP", "CREATE", self.stream, self.group, "0")
+        except RedisReplyError as e:
+            if "BUSYGROUP" not in str(e):
+                raise
+        self._group_ready = True
+
+    def _entries_to_blobs(self, entries) -> List[bytes]:
+        """Flatten [[id, [k, v, ...]], ...] into blobs, recording the
+        uri -> entry-id claim map ``ack_uris`` settles later."""
+        from analytics_zoo_tpu.serving.queues import _decode_request
+
+        blobs: List[bytes] = []
+        ack_now: List[str] = []
+        for entry in entries or []:
+            entry_id, kvs = entry[0], entry[1]
+            fields = {bytes(kvs[i]): kvs[i + 1]
+                      for i in range(0, len(kvs), 2)}
+            blob = fields.get(BLOB_FIELD)
+            if blob is None:
+                ack_now.append(entry_id)  # foreign entry: drop + ack,
+                continue                  # or it redelivers forever
+            blobs.append(blob)
+            entry_id = (entry_id.decode()
+                        if isinstance(entry_id, bytes) else entry_id)
+            if self.autoack:
+                ack_now.append(entry_id)
+                continue
+            try:
+                uri = _decode_request(blob)[0]
+            except Exception:
+                ack_now.append(entry_id)  # undecodable: the worker
+                continue                  # will drop it too
+            with self._plock:
+                self._pending.setdefault(uri, []).append(entry_id)
+                while len(self._pending) > 8192:
+                    # bound the claim map: oldest claims age out of
+                    # ack coverage (reclaim re-delivers them if the
+                    # worker truly never answered)
+                    self._pending.popitem(last=False)
+        if ack_now:
+            self._cmd("XACK", self.stream, self.group, *ack_now)
+        return blobs
+
+    def _claim(self, n: int) -> List[bytes]:
+        if self.group is None or self._paused:
+            return []
+        self._ensure_group()
+        blobs: List[bytes] = []
+        now = time.monotonic()
+        if self.reclaim_idle_ms > 0 and now >= self._next_reclaim:
+            self._next_reclaim = now + self.reclaim_idle_ms / 2000.0
+            reply = self._cmd("XAUTOCLAIM", self.stream, self.group,
+                              self.consumer,
+                              str(int(self.reclaim_idle_ms)), "0",
+                              "COUNT", str(n))
+            reclaimed = self._entries_to_blobs(reply[1] if reply else [])
+            if reclaimed:
+                _M_RECLAIMED.inc(len(reclaimed))
+                emit_event("stream_reclaim", "serving",
+                           stream=self.stream, group=self.group,
+                           n=len(reclaimed))
+                logger.warning(
+                    "reclaimed %d pending entries idle > %.0f ms on "
+                    "%s/%s (previous consumer presumed dead)",
+                    len(reclaimed), self.reclaim_idle_ms, self.stream,
+                    self.group)
+            blobs.extend(reclaimed)
+        if len(blobs) < n:
+            reply = self._cmd("XREADGROUP", "GROUP", self.group,
+                              self.consumer, "COUNT",
+                              str(n - len(blobs)), "STREAMS",
+                              self.stream, ">")
+            if reply:
+                blobs.extend(self._entries_to_blobs(reply[0][1]))
+        return blobs
+
+    def get(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        deadline = (None if timeout is None
+                    else time.monotonic() + max(0.0, timeout))
+        while True:
+            blobs = self._claim(1)
+            if blobs:
+                return blobs[0]
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(0.005)
+
+    def get_many(self, n: int) -> List[bytes]:
+        return self._claim(n)
+
+    def ack_uris(self, uris) -> None:
+        """Settle claims: called by the worker the moment a request's
+        reply is pushed (or its loss accounted). Only an acked entry
+        leaves the group's pending list -- everything else is
+        reclaimable after the idle threshold."""
+        if self.group is None:
+            return
+        ids: List[str] = []
+        with self._plock:
+            for uri in uris:
+                ids.extend(self._pending.pop(uri, ()))
+        if ids:
+            try:
+                self._cmd("XACK", self.stream, self.group, *ids)
+            except (OSError, RedisReplyError) as e:
+                # broker briefly away: the entries stay pending and
+                # re-deliver after the idle threshold -- duplicate
+                # work, never lost work
+                logger.warning("XACK of %d entries failed (%s); they "
+                               "will re-deliver after the idle "
+                               "threshold", len(ids), e)
+
+    def __len__(self) -> int:
+        n = self._cmd("XLEN", self.stream)
+        return int(n) if isinstance(n, int) else 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

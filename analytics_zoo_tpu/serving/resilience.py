@@ -330,6 +330,11 @@ class Supervisor:
             return None  # not running (or operator stopped it)
         if worker._stop.is_set():
             return None  # orderly shutdown in progress
+        drain = getattr(worker, "_drain", None)
+        if drain is not None and drain.is_set():
+            # graceful drain (ISSUE-9): the run exiting with its stop
+            # event unset is the POINT, not a crash to restart
+            return None
         if not thread.is_alive():
             return "crashed"
         now = time.monotonic()
@@ -393,8 +398,18 @@ class Supervisor:
         if self.ledger is None:
             return 0
         fresh, dead = self.ledger.take_for_requeue()
+        # consumer-group input (the fleet data plane): the BROKER
+        # still owns the dead run's claims -- they re-deliver via
+        # XAUTOCLAIM after the idle threshold. A local re-put here
+        # would add a second copy of each entry and race the reclaim
+        # into duplicate replies, so ownership stays with the broker;
+        # the ledger still marks them (a second crash during the
+        # re-serve takes the one-error-reply exit as before).
+        broker_owned = getattr(self.worker, "_acker", None) is not None
         requeued = 0
         for uri, blob in fresh:
+            if broker_owned:
+                continue
             try:
                 ok = self.worker._in.put(blob)
             except Exception as e:

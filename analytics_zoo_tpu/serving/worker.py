@@ -49,8 +49,7 @@ from analytics_zoo_tpu.serving.batcher import AdaptiveBatcher, MicroBatcher
 from analytics_zoo_tpu.serving.chaos import chaos_point
 from analytics_zoo_tpu.serving.protocol import (
     CIRCUIT_PREFIX, DEADLINE_PREFIX, ERROR_KEY)
-from analytics_zoo_tpu.serving.queues import (
-    TcpQueue, _decode_request, _encode)
+from analytics_zoo_tpu.serving.queues import _decode_request, _encode
 from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
@@ -325,6 +324,13 @@ class ServingWorker:
         # * heartbeat: stamped by every stage loop iteration, read by
         #   the Supervisor's wedge detector.
         self.ledger = None
+        # fleet ack seam (ISSUE-9): consumer-group input backends
+        # (RedisStreamQueue) expose ack_uris -- the worker settles a
+        # claim the moment it pushes the reply, so a replica SIGKILLed
+        # mid-serve leaves its claims pending for another replica to
+        # reclaim. None for every other backend: one getattr at
+        # construction, zero per-request cost
+        self._acker = getattr(self._in, "ack_uris", None)
         if breaker is None and bool(
                 cfg.get("zoo.serving.breaker.enabled", False)):
             from analytics_zoo_tpu.serving.resilience import (
@@ -332,6 +338,9 @@ class ServingWorker:
 
             breaker = CircuitBreaker()
         self.breaker = breaker
+        # drain flag (ISSUE-9): set-once per run; a draining engine
+        # stops pulling, finishes in-flight work, and exits cleanly
+        self._drain = threading.Event()
         self.heartbeat = time.monotonic()
         # decode stage's own heartbeat: None while no decode thread is
         # running (sync engine, bounded runs after their decode loop
@@ -345,6 +354,19 @@ class ServingWorker:
         self.served += n
         if n:
             _M_SERVED.inc(n)
+
+    def _ack_input(self, uris) -> None:
+        """Settle consumer-group claims for answered requests (no-op
+        off the fleet data plane). Ack failures are survivable: the
+        entries re-deliver after the idle threshold -- duplicate work,
+        never lost work."""
+        if self._acker is None:
+            return
+        try:
+            self._acker(uris)
+        except Exception as e:
+            logger.warning("input ack for %d request(s) failed: %s",
+                           len(tuple(uris)), e)
 
     # ------------------------------------------------- synchronous loop --
     def process_one_batch(self, wait_timeout: float = 1.0) -> int:
@@ -602,6 +624,10 @@ class ServingWorker:
                     # supervisor must not re-queue it after a later
                     # crash -- that would duplicate the reply
                     self.ledger.settle(uris)
+                # same settlement for brokered consumer-group claims
+                # (a SIGKILL before this line leaves them pending ->
+                # reclaimed by a surviving replica)
+                self._ack_input(uris)
             t1 = time.perf_counter()
             self._emit_spans("finalize", traces, t0, t1,
                              batch=len(uris))
@@ -698,7 +724,8 @@ class ServingWorker:
     # ---------------------------------------------- pipelined engine ----
     def _run_pipelined(self, max_batches: Optional[int],
                        wait_timeout: float,
-                       stop_ev: threading.Event) -> int:
+                       stop_ev: threading.Event,
+                       drain_ev: Optional[threading.Event] = None) -> int:
         """The staged engine: decode thread -> assembly/dispatch (this
         thread) -> finalize thread, bounded by ``pipeline_depth``
         dispatched batches in flight. A bounded run returns only after
@@ -727,6 +754,13 @@ class ServingWorker:
             pulled = 0
             try:
                 while not stop_ev.is_set() and not abort.is_set():
+                    if drain_ev is not None and drain_ev.is_set():
+                        # draining: stop pulling; the sentinel below
+                        # flushes everything already in the pipeline
+                        # through dispatch + finalize, then the run
+                        # exits cleanly -- the same clean-exit path a
+                        # bounded run takes
+                        break
                     # iterates at least every wait_timeout when idle
                     # (next_batch returns empty), so staleness means
                     # STUCK (hung broker recv, chaos stall), not idle
@@ -864,12 +898,13 @@ class ServingWorker:
         total requests served in this call."""
         stop_ev = self._stop  # capture: this RUN's stop event -- see
         # _run_pipelined's docstring for the restart semantics
+        drain_ev = self._drain  # same per-run capture
         if self.pipelined:
             return self._run_pipelined(max_batches, wait_timeout,
-                                       stop_ev)
+                                       stop_ev, drain_ev)
         total = 0
         batches = 0
-        while not stop_ev.is_set():
+        while not stop_ev.is_set() and not drain_ev.is_set():
             total += self.process_one_batch(wait_timeout=wait_timeout)
             batches += 1
             if max_batches is not None and batches >= max_batches:
@@ -896,6 +931,35 @@ class ServingWorker:
                        served=self.served)
             raise
 
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Graceful drain (ISSUE-9): stop pulling new work (the input
+        backend's ``pause`` seam, where it has one), let the engine
+        finish every request it already pulled, and wait up to
+        ``deadline_s`` (default ``zoo.serving.drain.deadline_ms``).
+        Returns True when the run fully drained inside the budget;
+        False means in-flight work is still finishing when the
+        deadline expired (the caller decides whether to hard-stop).
+        This is the seam SIGTERM and rolling restarts share."""
+        if deadline_s is None:
+            deadline_s = float(get_config().get(
+                "zoo.serving.drain.deadline_ms", 10000.0)) / 1000.0
+        pause = getattr(self._in, "pause", None)
+        if pause is not None:
+            pause()  # a brokered consumer must stop CLAIMING, not
+            # just stop pulling claimed work -- entries claimed after
+            # this point would sit until the reclaim threshold
+        self._drain.set()
+        thread = self._thread
+        if thread is None:
+            return True
+        thread.join(max(0.0, deadline_s))
+        if thread.is_alive():
+            return False
+        self._thread = None
+        while self._inflight:  # sync-engine leftovers
+            self._count_served(self._finalize_one())
+        return True
+
     def start(self) -> "ServingWorker":
         # a FRESH stop event per run (not .clear()): a previous run's
         # thread that is still draining -- or was abandoned by a
@@ -903,6 +967,7 @@ class ServingWorker:
         # keep seeing it set, or it would resume serving next to the
         # new thread
         self._stop = threading.Event()
+        self._drain = threading.Event()  # same per-run freshness
         self.heartbeat = time.monotonic()
         self._thread = threading.Thread(target=self.serve_forever,
                                         daemon=True)
@@ -943,15 +1008,19 @@ class ServingWorker:
                            uri)
 
     def _reply_backend(self, reply_to: Optional[str]):
-        """Default output backend, or the named stream on the same TCP
+        """Default output backend, or the named stream on the same
         broker when the request carried a reply-to (several frontends
-        sharing one broker each get their own results back)."""
+        sharing one broker each get their own results back). Brokered
+        backends (TcpQueue, RedisStreamQueue) expose ``for_stream``;
+        everything else ignores reply-to."""
         default = getattr(self._out_q, "queue", self._out_q)
-        if not reply_to or not isinstance(default, TcpQueue):
+        if not reply_to:
+            return default
+        maker = getattr(default, "for_stream", None)
+        if maker is None:
             return default
         if reply_to not in self._reply_queues:
-            self._reply_queues[reply_to] = TcpQueue(
-                f"tcp://{default._host}:{default._port}", name=reply_to)
+            self._reply_queues[reply_to] = maker(reply_to)
         return self._reply_queues[reply_to]
 
     def _push_error(self, uri: str, reply: Optional[str],
@@ -975,6 +1044,10 @@ class ServingWorker:
         if self.ledger is not None:
             self.ledger.settle((uri,))
         self._push(uri, reply, {ERROR_KEY: np.asarray(message)})
+        # ack AFTER the push: an error reply answers the request, so
+        # its stream claim settles on the same at-least-once contract
+        # as a result reply
+        self._ack_input((uri,))
 
     # --------------------------------------------------------- metrics --
     def metrics(self) -> Dict[str, Any]:

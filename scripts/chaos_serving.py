@@ -63,11 +63,52 @@ def main():
     ap.add_argument("--requests", type=int, default=2000)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--spec", default=DEFAULT_SPEC,
-                    help="chaos schedule (kind:seam[:k=v]*;...)")
+                    help="chaos schedule (kind:seam[:k=v]*;...); "
+                         "with --replicas, kill:replica:at=K entries "
+                         "SIGKILL whole replica processes")
     ap.add_argument("--deadline-ms", type=float, default=30000.0)
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--drain-timeout", type=float, default=60.0)
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="> 1 runs the soak against a real replica "
+                         "FLEET (delegates to fleet_soak.py: consumer"
+                         "-group sharding, SIGKILL of whole replicas, "
+                         "rolling restart)")
     args = ap.parse_args()
+
+    if args.replicas and args.replicas > 1:
+        # replica-level chaos lives in the fleet driver -- one soak
+        # convention, two granularities. The fleet driver honors
+        # requests/seed/drain-timeout and kill:replica spec entries
+        # ONLY; say so instead of silently eating the others.
+        import fleet_soak
+
+        dropped = []
+        if args.deadline_ms != 30000.0:
+            dropped.append("--deadline-ms")
+        if args.batch_size != 8:
+            dropped.append("--batch-size")
+        in_process = [e for e in args.spec.split(";")
+                      if e.strip() and "replica" not in e]
+        if in_process:
+            dropped.append(f"spec entries {';'.join(in_process)!r} "
+                           "(in-process seams only arm in single-"
+                           "worker mode)")
+        if dropped:
+            print("chaos_serving --replicas: ignoring "
+                  + ", ".join(dropped), file=sys.stderr)
+        sys.argv = [sys.argv[0],
+                    "--requests", str(args.requests),
+                    "--replicas", str(args.replicas),
+                    "--seed", str(args.seed),
+                    "--drain-timeout", str(args.drain_timeout)]
+        replica_entries = ";".join(
+            e for e in args.spec.split(";")
+            if e.strip() and "replica" in e)
+        if replica_entries:
+            sys.argv += ["--spec", replica_entries]
+        fleet_soak.main()
+        return
 
     from analytics_zoo_tpu.serving import chaos
     from analytics_zoo_tpu.serving.queues import (

@@ -9,16 +9,31 @@
 #     scripts/check_tree.sh              # full package lint + gate tests
 #     scripts/check_tree.sh --changed    # sub-second pre-push loop:
 #                                        # lint only files changed vs HEAD
+#     scripts/check_tree.sh --soak       # lint + a CI-sized fleet chaos
+#                                        # soak (2 replica processes, one
+#                                        # SIGKILL, rolling restart; ~2
+#                                        # min) -- the exactly-once gate
 #
-# Any extra arguments are forwarded to scripts/zoolint.py.
+# Any other arguments are forwarded to scripts/zoolint.py.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
+SOAK=0
+ARGS=()
+for a in "$@"; do
+    if [ "$a" = "--soak" ]; then SOAK=1; else ARGS+=("$a"); fi
+done
+
 echo "== zoolint =="
-python scripts/zoolint.py "$@"
+python scripts/zoolint.py "${ARGS[@]+"${ARGS[@]}"}"
 
 echo "== gate tests (test_zoolint, test_metric_names) =="
 python -m pytest tests/test_zoolint.py tests/test_metric_names.py \
     -q -p no:cacheprovider
+
+if [ "$SOAK" = 1 ]; then
+    echo "== fleet chaos soak (smoke) =="
+    python scripts/fleet_soak.py --smoke
+fi
