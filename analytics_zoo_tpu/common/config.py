@@ -153,6 +153,22 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.serving.fleet.autoscale.up_consecutive": 3,
     "zoo.serving.fleet.autoscale.down_consecutive": 10,
     "zoo.serving.fleet.autoscale.cooldown_s": 10.0,
+    # generation serving (serving/generation, ISSUE-10): the decode
+    # slot table size (concurrent streams per worker; ALSO the fixed
+    # device batch of every decode step), the paged KV cache geometry
+    # (page_size tokens per page; num_pages 0 = auto-size so every
+    # slot can reach max_len), the per-request length bounds
+    # (max_len = prompt + generated tokens a slot may span;
+    # max_tokens = default new-token budget when the request omits
+    # __max_tokens__), the idle poll interval of a decode loop with no
+    # active slots, and how many tokens ride each streamed reply chunk
+    "zoo.generation.slots": 8,
+    "zoo.generation.page_size": 16,
+    "zoo.generation.num_pages": 0,
+    "zoo.generation.max_len": 256,
+    "zoo.generation.max_tokens": 64,
+    "zoo.generation.step_idle_ms": 5.0,
+    "zoo.generation.stream_chunk_tokens": 1,
     # observability (analytics_zoo_tpu.obs): per-request tracing gate
     # (spans ride queue blobs as __trace__ and export as Chrome trace
     # JSON; off by default -- the disabled path must cost nothing),
@@ -257,6 +273,13 @@ _SPECS: Dict[str, tuple] = {
     "zoo.serving.fleet.autoscale.up_consecutive": ("int", 1, None),
     "zoo.serving.fleet.autoscale.down_consecutive": ("int", 1, None),
     "zoo.serving.fleet.autoscale.cooldown_s": ("float", 0, None),
+    "zoo.generation.slots": ("int", 1, None),
+    "zoo.generation.page_size": ("int", 1, None),
+    "zoo.generation.num_pages": ("int", 0, None),
+    "zoo.generation.max_len": ("int", 2, None),
+    "zoo.generation.max_tokens": ("int", 1, None),
+    "zoo.generation.step_idle_ms": ("float", 0, None),
+    "zoo.generation.stream_chunk_tokens": ("int", 1, None),
     "zoo.obs.trace.enabled": ("bool",),
     "zoo.obs.trace.max_spans": ("int", 1, None),
     "zoo.obs.report.interval": ("float", 0, None),

@@ -11,6 +11,10 @@ serves all threads (SURVEY.md section 7 step 7).
 from analytics_zoo_tpu.inference.inference_model import (  # noqa: F401
     InferenceModel,
 )
+from analytics_zoo_tpu.inference.kv_cache import (  # noqa: F401
+    CacheOverflow,
+    PagedKVCache,
+)
 from analytics_zoo_tpu.inference.sharded import (  # noqa: F401
     ShardPlan,
     resolve_shard_plan,

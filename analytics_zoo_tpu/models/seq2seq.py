@@ -86,28 +86,73 @@ class Seq2seq(ZooModel):
         return {"src": np.ones((1, 4), np.int32),
                 "tgt_in": np.ones((1, 4), np.int32)}
 
-    def infer(self, src, start_id: int, max_len: Optional[int] = None):
-        """Greedy generation (ref: Seq2seq.scala infer). Re-runs the
-        teacher-forced forward per emitted token (one jit compile,
-        max_len executions)."""
+    def infer(self, src, start_id: int, max_len: Optional[int] = None,
+              host_loop: bool = False):
+        """Greedy generation (ref: Seq2seq.scala infer).
+
+        Default: the whole greedy loop runs on-device inside ONE
+        jitted ``lax.fori_loop`` -- one dispatch per call instead of
+        one per emitted token (the ISSUE-10 satellite fix: the old
+        host loop paid ``max_len`` python->device round trips, which
+        dominated wall time on remote-device runtimes). One compile
+        per (batch, max_len) shape, cached on the model.
+
+        ``host_loop=True`` keeps the original per-token host loop --
+        the parity reference of ``tests/test_generation.py`` and the
+        escape hatch for duck-typed modules jit can't trace.
+        """
         max_len = max_len or self._config["max_len"]
         src = np.asarray(src, np.int32)
         est = self.estimator
         est._ensure_built({"src": src[:1], "tgt_in": src[:1, :1]})
         module = self.module
 
-        @jax.jit
-        def step(variables, src, tgt_in):
-            return module.apply(variables, {"src": src, "tgt_in": tgt_in})
+        if host_loop:
+            @jax.jit
+            def step(variables, src, tgt_in):
+                return module.apply(variables,
+                                    {"src": src, "tgt_in": tgt_in})
 
-        b = src.shape[0]
-        tgt_in = np.zeros((b, max_len), np.int32)
-        tgt_in[:, 0] = start_id
-        out = np.zeros((b, max_len), np.int32)
-        for t in range(max_len):
-            logits = np.asarray(step(est.variables, src, tgt_in))
-            tok = logits[:, t].argmax(-1).astype(np.int32)
-            out[:, t] = tok
-            if t + 1 < max_len:
-                tgt_in[:, t + 1] = tok
-        return out
+            b = src.shape[0]
+            tgt_in = np.zeros((b, max_len), np.int32)
+            tgt_in[:, 0] = start_id
+            out = np.zeros((b, max_len), np.int32)
+            for t in range(max_len):
+                logits = np.asarray(step(est.variables, src, tgt_in))
+                tok = logits[:, t].argmax(-1).astype(np.int32)
+                out[:, t] = tok
+                if t + 1 < max_len:
+                    tgt_in[:, t + 1] = tok
+            return out
+
+        fns = self.__dict__.setdefault("_infer_fns", {})
+        gen = fns.get(max_len)
+        if gen is None:
+            def gen_impl(variables, src_dev, start):
+                b = src_dev.shape[0]
+                # buffer one column wider than the window so the
+                # unconditional write at t+1 never needs a bounds
+                # branch; the forward always sees buf[:, :max_len]
+                buf0 = jnp.zeros((b, max_len + 1),
+                                 jnp.int32).at[:, 0].set(start)
+                out0 = jnp.zeros((b, max_len), jnp.int32)
+
+                def body(t, carry):
+                    buf, out = carry
+                    logits = module.apply(
+                        variables,
+                        {"src": src_dev,
+                         "tgt_in": jax.lax.slice_in_dim(
+                             buf, 0, max_len, axis=1)})
+                    tok = jnp.argmax(logits[:, t], -1).astype(
+                        jnp.int32)
+                    return (buf.at[:, t + 1].set(tok),
+                            out.at[:, t].set(tok))
+
+                _, out = jax.lax.fori_loop(0, max_len, body,
+                                           (buf0, out0))
+                return out
+
+            gen = fns[max_len] = jax.jit(gen_impl)
+        return np.asarray(gen(est.variables, src,
+                              jnp.int32(start_id)))

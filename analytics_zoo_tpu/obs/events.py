@@ -129,6 +129,18 @@ EVENT_TYPES: Dict[str, str] = {
     "stream_reclaim": "a consumer reclaimed pending stream entries "
                       "owned by a dead/stalled consumer "
                       "(fields: stream, group, n)",
+    # generation serving (ISSUE-10)
+    "generation_admit": "a generate request joined the running decode "
+                        "batch: prefill done, slot + KV pages "
+                        "committed (fields: uri, slot, prompt_len, "
+                        "bucket)",
+    "generation_complete": "a generation stream finished and released "
+                           "its slot (fields: uri, slot, tokens, "
+                           "reason)",
+    "generation_overflow": "a generate request was refused at "
+                           "admission: the paged KV cache had no free "
+                           "slot/pages (fields: uri, need_pages, "
+                           "free_pages, free_slots)",
     # learn lifecycle
     "train_start": "estimator fit() entered (fields: epochs, "
                    "batch_size)",

@@ -13,8 +13,12 @@ harness that proves it lives in ``chaos``. ``fleet`` scales all of it
 horizontally: N replica launcher processes sharding one consumer-group
 stream (``redis_adapter`` stream mode) behind a health-checking HTTP
 router, with drain-based rolling restarts and a metrics-driven
-autoscaler. The wire vocabulary -- reserved blob keys and structured
-error prefixes -- has ONE declaring module, ``protocol``
+autoscaler. ``generation`` adds the token-streaming data plane
+(ISSUE-10): prefill/decode split over a paged KV cache
+(``inference.kv_cache``), slot-based continuous batching, and chunked
+``POST /generate`` streams -- same supervisor/drain/chaos/fleet seams
+as the predict worker. The wire vocabulary -- reserved blob keys and
+structured error prefixes -- has ONE declaring module, ``protocol``
 (lint-enforced by zoolint's protocol family).
 """
 
@@ -29,6 +33,13 @@ from analytics_zoo_tpu.serving.batcher import (  # noqa: F401
     MicroBatcher,
 )
 from analytics_zoo_tpu.serving.worker import ServingWorker  # noqa: F401
+from analytics_zoo_tpu.serving.generation import (  # noqa: F401
+    ContinuousBatcher,
+    DecodeEngine,
+    GenerationWorker,
+    GenModelConfig,
+    TinyGenLM,
+)
 from analytics_zoo_tpu.serving.launcher import (  # noqa: F401
     ServingApp,
     launch,

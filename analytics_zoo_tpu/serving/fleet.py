@@ -239,6 +239,10 @@ class FleetRouter:
         router = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 for the chunked /generate relay; every other
+            # reply carries Content-Length so keep-alive stays correct
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):
                 logger.debug("fleet router: " + fmt, *args)
 
@@ -252,12 +256,16 @@ class FleetRouter:
                 self.wfile.write(body)
 
             def do_POST(self):
-                if self.path.split("?")[0] != "/predict":
+                route = self.path.split("?")[0]
+                if route not in ("/predict", "/generate"):
                     self._reply(404, json.dumps(
                         {"error": "not found"}).encode())
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                if route == "/generate":
+                    router.forward_generate(self, body)
+                    return
                 code, payload = router.forward_predict(body)
                 self._reply(code, payload)
 
@@ -319,7 +327,29 @@ class FleetRouter:
             (parts.hostname, parts.port), timeout=timeout_s)
         sock.close()
 
-    def forward_predict(self, body: bytes):
+    def _request_replica(self, path: str, body: bytes):
+        """The pre-delivery phase BOTH routes share: pick a routable
+        replica, connect-probe it, send the request -- retrying
+        pre-delivery failures (probe failure, 503 refusal, connection
+        failure) on another replica up to ``retries`` times. Returns
+        ``("resp", replica, open_response)`` on success (the caller
+        owns closing it: /predict consumes it whole, /generate relays
+        it), or ``("reply", status, body_bytes)`` for a verbatim
+        terminal answer (replica 4xx/5xx, mid-serve timeout, or
+        no-healthy-replica exhaustion).
+
+        Why each branch is (or is not) retried:
+        - probe failures (refused, reset, black-hole timeout) are all
+          pre-delivery: safe to retry elsewhere;
+        - a 503 is a REFUSAL (draining replica caught mid-quiesce,
+          shedding, open breaker): provably not served, duplicate-safe
+          to retry -- and it closes the quiesce-vs-in-flight race that
+          would otherwise leak a 503 through a rolling restart. The
+          replica stays healthy: refusing is policy, not death;
+        - any other HTTP answer is an application-level response, not
+          a dead replica: relay verbatim;
+        - a reply-phase timeout may be MID-SERVE: retrying could
+          double-serve, so surface the 504 instead."""
         tried: List[str] = []
         for attempt in range(self.retries + 1):
             rep = self.controller.pick_replica(exclude=tried)
@@ -327,8 +357,6 @@ class FleetRouter:
                 break
             tried.append(rep.name)
             try:
-                # probe failures (refused, reset, black-hole timeout)
-                # are all pre-delivery: safe to retry elsewhere
                 self._connect_probe(rep.address)
             except OSError as e:
                 self.controller.mark_unhealthy(
@@ -341,36 +369,24 @@ class FleetRouter:
                 continue
             try:
                 req = urllib.request.Request(
-                    rep.address + "/predict", data=body,
+                    rep.address + path, data=body,
                     headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(
-                        req, timeout=self.timeout_s) as resp:
-                    return resp.status, resp.read()
+                resp = urllib.request.urlopen(req,
+                                              timeout=self.timeout_s)
+                return "resp", rep, resp
             except urllib.error.HTTPError as e:
                 if e.code == 503 and attempt < self.retries:
-                    # a 503 is a REFUSAL (draining replica caught
-                    # mid-quiesce, shedding, open breaker): the
-                    # request was provably not served, so trying the
-                    # next replica is duplicate-safe -- and it closes
-                    # the quiesce-vs-in-flight race that would
-                    # otherwise leak a 503 through a rolling restart.
-                    # The replica stays healthy: refusing is policy,
-                    # not death.
                     _M_ROUTER_RETRIES.inc()
                     e.read()
                     continue
-                # any other answer (4xx/5xx): relay verbatim -- an
-                # application-level response is not a dead replica
-                return e.code, e.read()
+                return "reply", e.code, e.read()
             except (urllib.error.URLError, ConnectionError,
                     socket.timeout, OSError) as e:
                 reason = getattr(e, "reason", e)
                 if isinstance(reason, socket.timeout):
-                    # mid-serve timeout: retrying could double-serve;
-                    # surface the timeout instead
-                    return 504, json.dumps(
-                        {"error": "prediction timed out at replica "
-                                  f"{rep.name}"}).encode()
+                    return "reply", 504, json.dumps(
+                        {"error": f"{path.lstrip('/')} timed out at "
+                                  f"replica {rep.name}"}).encode()
                 self.controller.mark_unhealthy(
                     rep, f"connection failed: {reason}")
                 if attempt < self.retries:
@@ -378,11 +394,79 @@ class FleetRouter:
                     logger.warning(
                         "replica %s connection failed (%s); retrying "
                         "once on another replica", rep.name, reason)
-        return 503, json.dumps(
+        return "reply", 503, json.dumps(
             {"error": REPLICA_PREFIX,
              "detail": f"{REPLICA_PREFIX}: no healthy replica "
                        f"answered (tried {tried or 'none'})",
              "retry_after_s": 1}).encode()
+
+    def forward_predict(self, body: bytes):
+        kind, a, b = self._request_replica("/predict", body)
+        if kind == "reply":
+            return a, b
+        with b as resp:
+            return resp.status, resp.read()
+
+    def forward_generate(self, handler, body: bytes) -> None:
+        """Relay ``POST /generate`` to a routable replica, streaming
+        the replica's chunked SSE response through verbatim. Retry
+        policy is :meth:`_request_replica`'s -- once the first byte of
+        the stream has been relayed there is no retry (the stream is
+        mid-serve by definition)."""
+        kind, a, b = self._request_replica("/generate", body)
+        if kind == "reply":
+            handler._reply(a, b)
+            return
+        rep, resp = a, b
+        # stream open: relay line-by-line (SSE events are
+        # newline-framed) as our own chunked response
+        with resp:
+            _M_ROUTER_REQS.labels(code="200").inc()
+            handler.send_response(200)
+            handler.send_header(
+                "Content-Type",
+                resp.headers.get("Content-Type", "text/event-stream"))
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.end_headers()
+
+            def put(data: bytes) -> None:
+                handler.wfile.write(
+                    b"%X\r\n" % len(data) + data + b"\r\n")
+                handler.wfile.flush()
+
+            try:
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    put(line)
+                put_close = True
+            except (ConnectionError, BrokenPipeError,
+                    socket.timeout, OSError) as e:
+                # the REPLICA side died/stalled mid-stream: the
+                # /generate contract forbids a silent close, so try
+                # to hand the client a structured terminal event (and
+                # a valid chunked ending) -- unless it was the CLIENT
+                # side that went away, in which case these writes
+                # fail too and we just log
+                logger.warning("generate relay from %s ended "
+                               "early: %s", rep.name, e)
+                try:
+                    put(b"data: " + json.dumps(
+                        {"error": REPLICA_PREFIX,
+                         "detail": f"{REPLICA_PREFIX}: replica "
+                                   f"{rep.name} dropped the stream "
+                                   "mid-relay"}).encode() + b"\n\n")
+                    put_close = True
+                except (ConnectionError, BrokenPipeError, OSError):
+                    put_close = False
+            if put_close:
+                try:
+                    handler.wfile.write(b"0\r\n\r\n")
+                except (ConnectionError, BrokenPipeError,
+                        OSError) as e:
+                    logger.debug("relay close failed: %s", e)
+            handler.close_connection = True
 
     def health(self):
         counts = self.controller.replica_states()

@@ -1,0 +1,501 @@
+"""GenerationWorker: the token-streaming serving loop.
+
+The generation data plane's :class:`~..worker.ServingWorker`: pulls
+generate requests, admits them into the :class:`~.engine.DecodeEngine`
+slot table at step boundaries (continuous batching -- a request joins
+the running batch, it never waits for a batch window), and streams
+each slot's tokens back as chunked replies the moment they exist.
+
+Reply protocol (all chunks are ordinary wire blobs on the reply/output
+stream, so every queue backend and the fleet's consumer-group data
+plane carry them unchanged):
+
+- data chunk:      ``{__stream__: seq, token: [k] int32}``
+- terminal chunk:  data chunk + ``finish_reason`` ("stop" | "length")
+  and ``n_tokens``
+- error terminal:  ``{__stream__: -1, __error__: "<prefix>: detail"}``
+  -- ``generation_overflow`` for admission refusal (the frontend maps
+  it to 503 + Retry-After), ``deadline_exceeded`` when a stream's
+  budget ran out mid-decode (the structured mid-stream terminal chunk
+  the /generate contract promises).
+
+``seq`` increments per chunk from 0 and is the client's exactly-once
+dedup key: greedy decode is deterministic, so a supervisor-restarted
+stream (ledger re-queue) regenerates the same tokens and consumers
+drop ``seq <= last_seen``. Error terminals ride ``seq = -1`` so a
+post-restart failure is never mistaken for a stale duplicate.
+
+Lifecycle seams match ServingWorker exactly -- per-run stop/drain
+events, supervision heartbeat, ledger record/settle, consumer-group
+ack-on-reply, ``pull``/``decode``/``dispatch``/``finalize``/``push``
+chaos points -- so the Supervisor, the drain path, the fleet and the
+chaos harness drive both workers through one contract.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.inference.kv_cache import CacheOverflow
+from analytics_zoo_tpu.obs.events import emit as emit_event
+from analytics_zoo_tpu.obs.flight import get_inflight
+from analytics_zoo_tpu.obs.metrics import get_registry
+from analytics_zoo_tpu.obs.tracing import get_tracer
+from analytics_zoo_tpu.serving.chaos import chaos_point
+from analytics_zoo_tpu.serving.generation.batcher import (
+    ContinuousBatcher)
+from analytics_zoo_tpu.serving.protocol import (
+    DEADLINE_PREFIX, ERROR_KEY, GENERATION_PREFIX, INVALID_PREFIX,
+    STREAM_KEY)
+from analytics_zoo_tpu.serving.queues import _decode_generation, _encode
+
+logger = get_logger(__name__)
+
+_REG = get_registry()
+_M_REQS = _REG.counter(
+    "zoo_generation_requests_total",
+    "Generation streams answered (a terminal chunk was pushed: "
+    "completions and error terminals)")
+_M_TOKENS = _REG.counter(
+    "zoo_generation_tokens_total",
+    "Tokens generated across all streams (the numerator of the "
+    "deployment's tokens/sec)")
+_M_ERRORS = _REG.counter(
+    "zoo_generation_errors_total",
+    "Error terminal chunks pushed (admission refusals, mid-stream "
+    "deadlines, internal failures)")
+_M_OVERFLOW = _REG.counter(
+    "zoo_generation_overflow_total",
+    "Generate requests refused at admission because the paged KV "
+    "cache had no free slot/pages (503 + Retry-After at the frontend)")
+
+
+class _GenStream:
+    """Host-side state of one live stream (one engine slot)."""
+
+    __slots__ = ("uri", "reply", "trace", "deadline", "eos",
+                 "max_tokens", "produced", "pending", "seq",
+                 "admitted_at")
+
+    def __init__(self, uri, reply, trace, deadline, eos, max_tokens):
+        self.uri = uri
+        self.reply = reply
+        self.trace = trace
+        self.deadline = deadline
+        self.eos = eos
+        self.max_tokens = max_tokens
+        self.produced = 0      # tokens generated so far
+        self.pending: List[int] = []  # generated, not yet chunked
+        self.seq = 0           # next chunk sequence number
+        self.admitted_at = time.monotonic()
+
+
+class GenerationWorker:
+    """Continuous-batching generation server over the serving queues.
+
+    Args:
+      engine: a warmed :class:`~.engine.DecodeEngine`.
+      input_queue / output_queue: the serving queues (request blobs
+        carry ``tokens`` + the generation wire keys; chunks go to the
+        reply-to stream when the request names one, else the default
+        output queue -- the ServingWorker routing contract).
+      max_tokens / eos: per-deployment defaults when a request omits
+        ``__max_tokens__``/``__eos__`` (None reads
+        ``zoo.generation.max_tokens``; eos default -1 = none).
+      stream_chunk_tokens: tokens per data chunk (None reads
+        ``zoo.generation.stream_chunk_tokens``; 1 = stream every
+        token as it exists -- lowest TTFT-to-client, most chunks).
+    """
+
+    def __init__(self, engine, input_queue, output_queue,
+                 max_tokens: Optional[int] = None,
+                 eos: Optional[int] = None,
+                 stream_chunk_tokens: Optional[int] = None):
+        cfg = get_config()
+        self.engine = engine
+        self._in = getattr(input_queue, "queue", input_queue)
+        self._out_q = output_queue
+        self.batcher = ContinuousBatcher(self._in)
+        self.default_max_tokens = int(
+            cfg.get("zoo.generation.max_tokens", 64)
+            if max_tokens is None else max_tokens)
+        self.default_eos = -1 if eos is None else int(eos)
+        self.stream_chunk_tokens = max(1, int(
+            cfg.get("zoo.generation.stream_chunk_tokens", 1)
+            if stream_chunk_tokens is None else stream_chunk_tokens))
+        self.step_idle_s = float(
+            cfg.get("zoo.generation.step_idle_ms", 5.0)) / 1000.0
+        self._streams: Dict[int, _GenStream] = {}
+        self._reply_queues: Dict[str, Any] = {}
+        self.served = 0
+        # supervision / fleet seams (the ServingWorker contract): the
+        # Supervisor reads heartbeat/_thread/_stop/_drain and clears
+        # _inflight on restart; consumer-group backends expose
+        # ack_uris; a Supervisor attaches the ledger
+        self.ledger = None
+        self._acker = getattr(self._in, "ack_uris", None)
+        self._stop = threading.Event()
+        self._drain = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._inflight: collections.deque = collections.deque()
+        self.heartbeat = time.monotonic()
+        self.heartbeat_decode: Optional[float] = None
+
+    # ----------------------------------------------------------- run --
+    def run(self, max_steps: Optional[int] = None,
+            wait_timeout: Optional[float] = None) -> int:
+        """Serve until stopped (or ``max_steps`` decode steps);
+        returns terminal replies pushed in this call. A draining run
+        admits nothing new, finishes every live stream, then exits
+        cleanly -- the seam SIGTERM and rolling restarts share.
+        ``wait_timeout`` is the idle poll patience; None reads
+        ``zoo.generation.step_idle_ms`` (bounded runs/tests pass their
+        own)."""
+        stop_ev = self._stop  # per-run capture: a supervisor restart
+        drain_ev = self._drain  # hands the next run fresh events
+        idle_wait = (self.step_idle_s if wait_timeout is None
+                     else wait_timeout)
+        total = 0
+        steps = 0
+        while not stop_ev.is_set():
+            self.heartbeat = time.monotonic()
+            draining = drain_ev.is_set()
+            if not draining:
+                free = self.engine.free_slots()
+                if free > 0:
+                    idle = not self._streams
+                    blobs = self.batcher.poll(
+                        free, wait_timeout=idle_wait, idle=idle)
+                    for blob in blobs:
+                        total += self._admit_blob(blob)
+            if not self._streams:
+                if draining:
+                    break
+                if max_steps is not None and steps >= max_steps:
+                    break
+                continue  # the idle poll above already waited
+            chaos_point("dispatch")
+            try:
+                results = self.engine.step()
+            except Exception as e:
+                # a step failure strands every live stream: give each
+                # one structured terminal error instead of a silent
+                # stall (the engine's slot state stays consistent --
+                # step() commits nothing on raise)
+                logger.exception("generation step failed: %s", e)
+                for slot in list(self._streams):
+                    total += self._abort_stream(
+                        slot, f"generation step failed: {e}")
+                continue
+            steps += 1
+            total += self._finalize_results(results)
+            if max_steps is not None and steps >= max_steps:
+                break
+        return total
+
+    def serve_forever(self) -> None:
+        try:
+            self.run()
+        except BaseException as e:
+            emit_event("worker_crash", "generation",
+                       error=repr(e)[:500], served=self.served)
+            raise
+
+    # ----------------------------------------------------- admission --
+    def _admit_blob(self, blob: bytes) -> int:
+        """Decode + admit one request at a step boundary; returns the
+        terminal replies pushed (0 for a live admission, 1 when the
+        request was refused/expired/finished instantly)."""
+        chaos_point("decode")
+        try:
+            (uri, tensors, reply, trace, deadline, max_toks,
+             eos) = _decode_generation(blob)
+        except Exception as e:
+            logger.exception(
+                "generation: undecodable request dropped: %s", e)
+            return 0
+        if self.ledger is not None:
+            self.ledger.record(uri, blob)
+        if deadline is not None and time.time() > deadline:
+            self._push_error(
+                uri, reply,
+                f"{DEADLINE_PREFIX}: request missed its deadline "
+                "before admission")
+            return 1
+        if max_toks is None:
+            max_toks = self.default_max_tokens
+        # admission always yields at least the prefill's first token,
+        # so a <1 budget (direct-queue clients; the frontend already
+        # 400s it) is served as 1, not refused
+        max_toks = max(1, int(max_toks))
+        if eos is None:
+            eos = self.default_eos
+        prompt = tensors.get("tokens")
+        if prompt is None and len(tensors) == 1:
+            prompt = next(iter(tensors.values()))
+        if prompt is None:
+            self._push_error(
+                uri, reply,
+                f"{INVALID_PREFIX}: generate request needs a "
+                "'tokens' tensor (int prompt)")
+            return 1
+        t0 = time.perf_counter()
+        try:
+            slot, tok0 = self.engine.admit(prompt, max_toks)
+        except ValueError as e:
+            # malformed CLIENT content past the frontend's shape
+            # checks (out-of-vocab ids, empty prompt): a structured
+            # 400, a warning (no traceback -- an unauthenticated
+            # client must not be able to flood exception logs or make
+            # bad input read as server faults)
+            logger.warning("generation: invalid request %s: %s",
+                           uri, e)
+            self._push_error(uri, reply, f"{INVALID_PREFIX}: {e}")
+            return 1
+        except CacheOverflow as e:
+            _M_OVERFLOW.inc()
+            stats = self.engine.cache.stats()
+            emit_event("generation_overflow", "generation", uri=uri,
+                       need_pages=self.engine.cache.pages_for(
+                           int(np.asarray(prompt).size) + max_toks),
+                       free_pages=stats["num_pages"]
+                       - stats["pages_assigned"],
+                       free_slots=stats["slots_free"])
+            self._push_error(uri, reply, f"{GENERATION_PREFIX}: {e}")
+            return 1
+        except Exception as e:
+            logger.exception("generation admit failed for %s: %s",
+                             uri, e)
+            self._push_error(uri, reply, str(e))
+            return 1
+        if trace:
+            get_tracer().add_span("gen_prefill", trace, t0,
+                                  time.perf_counter())
+        get_inflight().add((uri,))
+        stream = _GenStream(uri, reply, trace, deadline, eos, max_toks)
+        self._streams[slot] = stream
+        emit_event("generation_admit", "generation", uri=uri,
+                   slot=slot, prompt_len=int(np.asarray(prompt).size),
+                   bucket=next(b for b in self.engine.ladder
+                               if b >= np.asarray(prompt).size))
+        return self._accept_token(slot, stream, tok0)
+
+    # ------------------------------------------------------ stepping --
+    def _finalize_results(self, results) -> int:
+        """Route one decode step's tokens into their streams: deadline
+        checks, chunk flushes, terminal pushes. Returns terminal
+        replies pushed."""
+        chaos_point("finalize")
+        n = 0
+        for slot, tok in results:
+            stream = self._streams.get(slot)
+            if stream is None:
+                continue  # lane freed earlier this same step batch
+            if (stream.deadline is not None
+                    and time.time() > stream.deadline):
+                n += self._abort_stream(
+                    slot,
+                    f"{DEADLINE_PREFIX}: stream missed its deadline "
+                    f"after {stream.produced} tokens")
+                continue
+            n += self._accept_token(slot, stream, tok)
+        return n
+
+    def _accept_token(self, slot: int, stream: _GenStream,
+                      tok: int) -> int:
+        """Append one generated token; flush/terminate as policy
+        dictates. Returns 1 when this token finished the stream."""
+        stream.pending.append(int(tok))
+        stream.produced += 1
+        _M_TOKENS.inc()
+        if stream.eos >= 0 and int(tok) == stream.eos:
+            return self._finish_stream(slot, stream, "stop")
+        if stream.produced >= stream.max_tokens:
+            return self._finish_stream(slot, stream, "length")
+        if len(stream.pending) >= self.stream_chunk_tokens:
+            self._push_chunk(stream)
+        return 0
+
+    # -------------------------------------------------------- pushes --
+    def _push_chunk(self, stream: _GenStream, final: bool = False,
+                    reason: Optional[str] = None) -> None:
+        payload: Dict[str, np.ndarray] = {
+            STREAM_KEY: np.asarray(stream.seq, np.int32)}
+        if stream.pending:
+            payload["token"] = np.asarray(stream.pending, np.int32)
+        if final:
+            payload["finish_reason"] = np.asarray(reason)
+            payload["n_tokens"] = np.asarray(stream.produced, np.int32)
+        stream.seq += 1
+        stream.pending = []
+        if chaos_point("push"):
+            return  # injected drop-chunk
+        backend = self._reply_backend(stream.reply)
+        if not backend.put(_encode(stream.uri, payload)):
+            logger.warning("output queue full: dropping chunk for %s",
+                           stream.uri)
+
+    def _finish_stream(self, slot: int, stream: _GenStream,
+                       reason: str) -> int:
+        """Terminal chunk + slot release + settlement: the stream
+        leaves the running batch at this step boundary."""
+        self._push_chunk(stream, final=True, reason=reason)
+        self._settle(stream.uri)
+        emit_event("generation_complete", "generation", uri=stream.uri,
+                   slot=slot, tokens=stream.produced, reason=reason)
+        if stream.trace:
+            get_tracer().add_span(
+                "gen_stream", stream.trace, stream.admitted_at,
+                time.monotonic(), tokens=stream.produced)
+        self.engine.release(slot)
+        self._streams.pop(slot, None)
+        self.served += 1
+        _M_REQS.inc()
+        return 1
+
+    def _abort_stream(self, slot: int, message: str) -> int:
+        """Mid-stream failure: structured error terminal, then the
+        slot frees exactly like a completion."""
+        stream = self._streams.pop(slot, None)
+        if stream is None:
+            return 0
+        self._push_error(stream.uri, stream.reply, message)
+        self.engine.release(slot)
+        self.served += 1
+        return 1
+
+    def _push_error(self, uri: str, reply: Optional[str],
+                    message: str) -> None:
+        """Error terminal chunk (``seq = -1``: never deduped away).
+        Also the Supervisor's ``_reply_error`` seam -- give-up and
+        double-crash replies arrive through here."""
+        _M_ERRORS.inc()
+        _M_REQS.inc()
+        if message.startswith(DEADLINE_PREFIX):
+            emit_event("deadline_exceeded", "generation", uri=uri,
+                       error=message[:500])
+        elif not message.startswith((GENERATION_PREFIX,
+                                     INVALID_PREFIX)):
+            # overflow refusals already emitted generation_overflow
+            # with capacity fields, and invalid_request is client
+            # noise an unauthenticated caller could use to churn the
+            # event ring; everything else is rare by construction ->
+            # one structured event per error
+            emit_event("serving_error", "generation", uri=uri,
+                       error=message[:500])
+        self._settle(uri)
+        payload = {STREAM_KEY: np.asarray(-1, np.int32),
+                   ERROR_KEY: np.asarray(message)}
+        if chaos_point("push"):
+            return
+        backend = self._reply_backend(reply)
+        if not backend.put(_encode(uri, payload)):
+            logger.warning("output queue full: dropping error for %s",
+                           uri)
+
+    def _settle(self, uri: str) -> None:
+        """One settlement point: ledger + crash-manifest + stream-claim
+        ack -- the request is answered, nothing may re-serve it."""
+        get_inflight().discard((uri,))
+        if self.ledger is not None:
+            self.ledger.settle((uri,))
+        if self._acker is not None:
+            try:
+                self._acker((uri,))
+            except Exception as e:
+                logger.warning("input ack for %s failed: %s", uri, e)
+
+    def _reply_backend(self, reply_to: Optional[str]):
+        default = getattr(self._out_q, "queue", self._out_q)
+        if not reply_to:
+            return default
+        maker = getattr(default, "for_stream", None)
+        if maker is None:
+            return default
+        if reply_to not in self._reply_queues:
+            self._reply_queues[reply_to] = maker(reply_to)
+        return self._reply_queues[reply_to]
+
+    # ----------------------------------------------------- lifecycle --
+    def start(self) -> "GenerationWorker":
+        # fresh per-run events (the ServingWorker restart contract);
+        # slots a dead run left occupied are released here -- their
+        # requests are ledger-outstanding and re-arrive via the
+        # supervisor's re-queue, regenerating deterministically
+        self._reset_streams()
+        self._stop = threading.Event()
+        self._drain = threading.Event()
+        self.heartbeat = time.monotonic()
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True,
+                                        name="generation-worker")
+        self._thread.start()
+        emit_event("worker_start", "generation",
+                   slots=self.engine.num_slots,
+                   max_tokens=self.default_max_tokens)
+        return self
+
+    def _reset_streams(self) -> None:
+        for slot in list(self._streams):
+            self._streams.pop(slot, None)
+            self.engine.release(slot)
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        emit_event("worker_stop", "generation", served=self.served)
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(join_timeout)
+            if thread.is_alive():
+                logger.warning(
+                    "generation worker still busy after %.1fs",
+                    join_timeout)
+                return
+            self._thread = None
+
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Stop admitting, finish every live stream, within the
+        budget (default ``zoo.serving.drain.deadline_ms``). True =
+        fully drained in time."""
+        if deadline_s is None:
+            deadline_s = float(get_config().get(
+                "zoo.serving.drain.deadline_ms", 10000.0)) / 1000.0
+        pause = getattr(self._in, "pause", None)
+        if pause is not None:
+            pause()  # brokered consumer: stop CLAIMING, not just
+            # stop pulling claimed entries
+        self._drain.set()
+        thread = self._thread
+        if thread is None:
+            return True
+        thread.join(max(0.0, deadline_s))
+        if thread.is_alive():
+            return False
+        self._thread = None
+        return True
+
+    # ------------------------------------------------------- metrics --
+    def metrics(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "served": self.served,
+            "streams_active": len(self._streams),
+            "engine": self.engine.stats(),
+            "batcher": self.batcher.stats(),
+            "defaults": {"max_tokens": self.default_max_tokens,
+                         "eos": self.default_eos,
+                         "chunk_tokens": self.stream_chunk_tokens},
+        }
+        try:
+            out["queue_depth"] = len(self._in)
+        except (TypeError, OSError):
+            pass
+        if self.ledger is not None:
+            out["ledger_outstanding"] = len(self.ledger)
+        return out

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue as _pyqueue
 import sys
 import threading
 import time
@@ -45,7 +46,8 @@ from analytics_zoo_tpu.obs.events import get_event_log, to_jsonable
 from analytics_zoo_tpu.obs.flight import get_inflight
 from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.serving.protocol import (
-    DRAINING_PREFIX, ERROR_KEY, error_status)
+    DEADLINE_PREFIX, DRAINING_PREFIX, ERROR_KEY, STREAM_KEY,
+    error_status)
 from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
@@ -66,19 +68,26 @@ _M_HTTP_DROPPED = _REG.counter(
 # everything else (scanners probing arbitrary 404 paths) collapses to
 # "other" so client-supplied URLs cannot grow the registry unboundedly
 _KNOWN_ROUTES = frozenset(
-    ("/predict", "/metrics", "/metrics.json", "/healthz", "/trace",
-     "/debug/events", "/debug/vars", "/"))
+    ("/predict", "/generate", "/metrics", "/metrics.json", "/healthz",
+     "/trace", "/debug/events", "/debug/vars", "/"))
 
 
 class _ResultRouter:
     """Drains the OutputQueue into per-uri mailboxes. Only uris
     registered as pending get a mailbox; results for abandoned uris
-    (request already timed out) are dropped, so timeouts don't leak."""
+    (request already timed out) are dropped, so timeouts don't leak.
+
+    Two mailbox kinds: one-shot results (predict -- one blob, then the
+    waiter owns cleanup) and *stream* mailboxes (generate, ISSUE-10 --
+    a Queue of chunks, recognized by ``__stream__`` riding the reply
+    blob; a stream stays registered until its handler unregisters it,
+    so a multi-chunk reply never races its own registration)."""
 
     def __init__(self, output_queue):
         self._q = output_queue
         self._pending: set = set()
         self._results: Dict[str, Dict[str, np.ndarray]] = {}
+        self._streams: Dict[str, _pyqueue.Queue] = {}
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -100,6 +109,20 @@ class _ResultRouter:
             if item is None:
                 continue
             uri, tensors = item
+            if STREAM_KEY in tensors:
+                # generation chunk: route into the stream mailbox
+                # (debug-level drop log -- an abandoned stream keeps
+                # producing chunks until the worker finishes it, and a
+                # warning per chunk would flood the log)
+                with self._cv:
+                    sq = self._streams.get(uri)
+                if sq is not None:
+                    sq.put(tensors)
+                else:
+                    _M_HTTP_DROPPED.inc()
+                    logger.debug("dropping chunk for abandoned "
+                                 "stream %s", uri)
+                continue
             with self._cv:
                 if uri in self._pending:
                     self._results[uri] = tensors
@@ -112,6 +135,18 @@ class _ResultRouter:
     def register(self, uri: str) -> None:
         with self._cv:
             self._pending.add(uri)
+
+    def register_stream(self, uri: str) -> _pyqueue.Queue:
+        """Open a stream mailbox; every chunk blob for ``uri`` lands
+        in the returned Queue until :meth:`unregister_stream`."""
+        sq: _pyqueue.Queue = _pyqueue.Queue()
+        with self._cv:
+            self._streams[uri] = sq
+        return sq
+
+    def unregister_stream(self, uri: str) -> None:
+        with self._cv:
+            self._streams.pop(uri, None)
 
     def unregister(self, uri: str) -> None:
         """Abandon a registered uri (request failed before/without its
@@ -163,10 +198,17 @@ class HttpFrontend:
                  request_timeout: float = 10.0,
                  timer: Optional[Timer] = None,
                  certfile: Optional[str] = None,
-                 keyfile: Optional[str] = None):
+                 keyfile: Optional[str] = None,
+                 gen_queue=None, gen_worker=None):
         self._in = input_queue
         self.router = _ResultRouter(output_queue)
         self.worker = worker
+        # generation serving (ISSUE-10): the generate-request input
+        # queue and worker; None = POST /generate answers 404. Chunks
+        # arrive on the SAME output queue the router drains (routed by
+        # the __stream__ key), so there is still exactly one drainer.
+        self._gen_in = gen_queue
+        self.gen_worker = gen_worker
         self.request_timeout = request_timeout
         self.retry_after_s = float(get_config().get(
             "zoo.serving.shed.retry_after_s", 1.0))
@@ -181,6 +223,11 @@ class HttpFrontend:
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: chunked transfer encoding for streamed
+            # /generate responses (every non-streamed reply still
+            # carries Content-Length, so keep-alive stays correct)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # route to our logger
                 logger.debug("http: " + fmt, *args)
 
@@ -236,7 +283,8 @@ class HttpFrontend:
                                       "path": self.path})
 
             def do_POST(self):
-                if self.path.split("?")[0] != "/predict":
+                route = self.path.split("?")[0]
+                if route not in ("/predict", "/generate"):
                     self._reply(404, {"error": "not found",
                                       "path": self.path})
                     return
@@ -246,16 +294,45 @@ class HttpFrontend:
                 except (ValueError, json.JSONDecodeError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
+                if route == "/generate":
+                    frontend.handle_generate(self, req)
+                    return
                 with frontend.timer.timing("predict_request"):
                     code, payload = frontend.handle_predict(req)
-                headers = None
-                if code == 503:
-                    # load-shed / backpressure contract: every refused
-                    # /predict carries Retry-After so well-behaved
-                    # clients back off instead of hammering the queue
-                    headers = {"Retry-After": str(max(1, int(
-                        frontend.retry_after_s)))}
-                self._reply(code, payload, headers=headers)
+                self._reply(code, payload,
+                            headers=frontend._retry_headers(code))
+
+            # ------------------------- chunked stream helpers -------
+            def begin_stream(self) -> None:
+                """Response head of a streamed /generate: chunked
+                transfer, SSE content type. Counted here -- _reply
+                never runs for a streamed response."""
+                _M_HTTP_REQS.labels(route="/generate",
+                                    code="200").inc()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+            def write_event(self, obj: Any) -> bool:
+                """One SSE event as one HTTP chunk; False = client
+                went away (the caller stops relaying)."""
+                data = b"data: " + json.dumps(obj).encode() + b"\n\n"
+                try:
+                    self.wfile.write(b"%X\r\n" % len(data) + data
+                                     + b"\r\n")
+                    self.wfile.flush()
+                    return True
+                except (ConnectionError, BrokenPipeError, OSError):
+                    return False
+
+            def end_stream(self) -> None:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except (ConnectionError, BrokenPipeError, OSError) as e:
+                    logger.debug("stream close failed: %s", e)
+                self.close_connection = True
 
         if self._tls:
             # HTTPS (ref: FrontEndApp.scala:40-130 supports --https-*
@@ -410,6 +487,197 @@ class HttpFrontend:
             return 500, {"error": msg}
         return 200, _to_jsonable(result)
 
+    def _retry_headers(self, code: int) -> Optional[Dict[str, str]]:
+        """Every 503 carries Retry-After (the load-shed / drain /
+        overflow backoff contract shared by /predict and /generate)."""
+        if code != 503:
+            return None
+        return {"Retry-After": str(max(1, int(self.retry_after_s)))}
+
+    # ------------------------------------------------------ generation --
+    def handle_generate(self, handler, req: Any) -> None:
+        """``POST /generate`` (ISSUE-10): enqueue a generate request
+        and relay its chunk stream. ``stream: true`` (default) answers
+        chunked SSE -- one ``data: {...}`` event per token chunk, a
+        terminal event carrying ``finish_reason`` (or a structured
+        ``error``); ``stream: false`` collects the whole stream into
+        one JSON reply. The per-request deadline is honored across the
+        stream: expiry mid-stream produces a structured
+        ``deadline_exceeded`` terminal event, never a silent close."""
+        with tracing.maybe_trace("http_generate") as trace_id:
+            code, err, uri, stream_q, streaming = \
+                self._generate_setup(req)
+            if uri is None:
+                handler._reply(code, err,
+                               headers=self._retry_headers(code))
+                return
+            try:
+                if streaming:
+                    self._stream_generate(handler, uri, stream_q,
+                                          trace_id)
+                else:
+                    code, payload = self._collect_generate(
+                        uri, stream_q, trace_id)
+                    handler._reply(code, payload,
+                                   headers=self._retry_headers(code))
+            finally:
+                self.router.unregister_stream(uri)
+
+    def _generate_setup(self, req: Any):
+        """Validate + enqueue; returns (code, error_payload, uri,
+        stream_queue, streaming) with uri None on refusal."""
+        if self._gen_in is None:
+            return 404, {"error": "generation serving is not enabled "
+                                  "on this deployment"}, None, None, \
+                False
+        if self._draining:
+            return 503, {"error": DRAINING_PREFIX,
+                         "detail": f"{DRAINING_PREFIX}: deployment "
+                                   "is draining for restart",
+                         "retry_after_s": self.retry_after_s}, \
+                None, None, False
+        if not isinstance(req, dict):
+            return 400, {"error": "body must be a JSON object"}, \
+                None, None, False
+        prompt = req.get("prompt", req.get("tokens"))
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) and not isinstance(
+                    t, bool) for t in prompt)):
+            return 400, {"error": "'prompt' must be a non-empty list "
+                                  "of token ids"}, None, None, False
+        max_tokens = req.get("max_tokens")
+        eos = req.get("eos")
+        for name, v in (("max_tokens", max_tokens), ("eos", eos)):
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, int)):
+                return 400, {"error": f"'{name}' must be an int"}, \
+                    None, None, False
+        if max_tokens is not None and max_tokens < 1:
+            # admission always yields the prefill's first token, so a
+            # <1 budget cannot be honored -- refuse up front instead
+            # of billing a prefill for a token nobody asked for
+            return 400, {"error": "'max_tokens' must be >= 1"}, \
+                None, None, False
+        streaming = bool(req.get("stream", True))
+        uri = uuid.uuid4().hex
+        stream_q = self.router.register_stream(uri)
+        if not self._gen_in.enqueue_generation(
+                uri, np.asarray(prompt, np.int32),
+                max_tokens=max_tokens, eos=eos):
+            self.router.unregister_stream(uri)
+            return 503, {"error": "overloaded: generation queue "
+                                  "refused the request",
+                         "retry_after_s": self.retry_after_s}, \
+                None, None, False
+        return 200, None, uri, stream_q, streaming
+
+    @staticmethod
+    def _parse_chunk(tensors: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Wire chunk -> event dict: {seq, token?, finish_reason?,
+        n_tokens?} or {seq, error, detail}."""
+        ev: Dict[str, Any] = {"seq": int(np.asarray(
+            tensors[STREAM_KEY]).reshape(()))}
+        if ERROR_KEY in tensors:
+            msg = str(np.asarray(tensors[ERROR_KEY]).reshape(()))
+            ev["error"] = msg.split(":", 1)[0]
+            ev["detail"] = msg
+            return ev
+        if "token" in tensors:
+            ev["token"] = [int(t) for t in
+                           np.asarray(tensors["token"]).reshape(-1)]
+        if "finish_reason" in tensors:
+            ev["finish_reason"] = str(np.asarray(
+                tensors["finish_reason"]).reshape(()))
+            ev["n_tokens"] = int(np.asarray(
+                tensors.get("n_tokens", 0)).reshape(()))
+        return ev
+
+    def _next_chunk(self, stream_q, deadline: float
+                    ) -> Optional[Dict[str, Any]]:
+        """Next parsed chunk event, or None when the request deadline
+        expired first."""
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                tensors = stream_q.get(timeout=min(remaining, 0.25))
+            except _pyqueue.Empty:
+                continue
+            return self._parse_chunk(tensors)
+
+    def _stream_generate(self, handler, uri: str, stream_q,
+                         trace_id: Optional[str]) -> None:
+        handler.begin_stream()
+        meta: Dict[str, Any] = {"uri": uri}
+        if trace_id is not None:
+            meta["trace_id"] = trace_id
+        alive = handler.write_event(meta)
+        last_seq = -1
+        while alive:
+            # request_timeout here is an inter-chunk STALL detector
+            # (reset per chunk): the TOTAL stream budget is the wire
+            # deadline (zoo.serving.deadline_ms), which the worker
+            # enforces with its own structured terminal chunk -- a
+            # healthy long stream must not be killed mid-flow by the
+            # frontend's (predict-sized) total timeout
+            ev = self._next_chunk(
+                stream_q, time.monotonic() + self.request_timeout)
+            if ev is None:
+                # chunks stopped arriving -> STRUCTURED terminal
+                # chunk, not a silent close (the /generate contract)
+                handler.write_event(
+                    {"error": DEADLINE_PREFIX,
+                     "detail": f"{DEADLINE_PREFIX}: stream stalled "
+                               "(no chunk inside the request "
+                               "timeout)"})
+                break
+            if "error" in ev:
+                handler.write_event(ev)
+                break
+            if ev["seq"] <= last_seq:
+                continue  # supervisor-restart replay: already relayed
+            last_seq = ev["seq"]
+            alive = handler.write_event(ev)
+            if "finish_reason" in ev:
+                break
+        handler.end_stream()
+
+    def _collect_generate(self, uri: str, stream_q,
+                          trace_id: Optional[str]):
+        """``stream: false``: assemble the chunk stream into one JSON
+        reply (error prefixes map to HTTP statuses exactly like
+        /predict error replies). Same inter-chunk STALL semantics as
+        the streaming path -- a healthy long stream must not 504 just
+        because its total exceeds the predict-sized request_timeout
+        (the total budget is the wire deadline's job)."""
+        toks: list = []
+        last_seq = -1
+        while True:
+            ev = self._next_chunk(
+                stream_q, time.monotonic() + self.request_timeout)
+            if ev is None:
+                return 504, {"error": "generation stalled (no chunk "
+                                      "inside the request timeout)"}
+            if "error" in ev:
+                status = error_status(ev["detail"])
+                return ((status, {"error": ev["error"],
+                                  "detail": ev["detail"],
+                                  "retry_after_s": self.retry_after_s})
+                        if status is not None
+                        else (500, {"error": ev["detail"]}))
+            if ev["seq"] <= last_seq:
+                continue
+            last_seq = ev["seq"]
+            toks.extend(ev.get("token", ()))
+            if "finish_reason" in ev:
+                out = {"tokens": toks,
+                       "finish_reason": ev["finish_reason"],
+                       "n_tokens": ev["n_tokens"]}
+                if trace_id is not None:
+                    out["trace_id"] = trace_id
+                return 200, out
+
     # -------------------------------------------------------- lifecycle --
     @property
     def address(self):
@@ -445,6 +713,8 @@ class HttpFrontend:
             pass
         if self.worker is not None:
             out["worker"] = self.worker.metrics()
+        if self.gen_worker is not None:
+            out["generation"] = self.gen_worker.metrics()
         out["registry"] = get_registry().snapshot()
         return out
 
@@ -520,10 +790,14 @@ class HttpFrontend:
         serving thread has died (a stopped or inline-run worker is not
         a failure -- there is no thread to have died), or while the
         deployment is draining (in-flight work finishing; no new
-        traffic wanted)."""
+        traffic wanted). A deployment hosting both data planes is
+        healthy only when BOTH workers' threads live."""
         worker = self.worker
         thread = getattr(worker, "_thread", None)
         alive = thread is None or thread.is_alive()
+        gen = self.gen_worker
+        gen_thread = getattr(gen, "_thread", None)
+        alive = alive and (gen_thread is None or gen_thread.is_alive())
         status = (DRAINING_PREFIX if self._draining
                   else "ok" if alive else "worker_dead")
         payload = {
@@ -533,4 +807,6 @@ class HttpFrontend:
         if worker is not None:
             payload["served"] = worker.served
             payload["pipelined"] = worker.pipelined
+        if gen is not None:
+            payload["generation_served"] = gen.served
         return (200 if alive and not self._draining else 503), payload

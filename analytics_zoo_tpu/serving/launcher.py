@@ -45,6 +45,23 @@ Config schema (defaults in parentheses)::
       port: 0                            # 0 = pick a free port
       certfile: null                     # both set -> HTTPS (ref:
       keyfile: null                      #   FrontEndApp https options)
+    generation:                          # token streaming (ISSUE-10);
+      enabled: true                      #   presence enables it. With
+      model:                             #   no model: block the app
+        vocab: 64                        #   serves generation ONLY.
+        dim: 32                          # GenModelConfig fields (the
+        heads: 2                         #   seeded builtin LM)
+        head_dim: 16
+        layers: 2
+        seed: 0
+      stream: generation_stream          # brokered request stream
+      slots: null                        # null = zoo.generation.*
+      page_size: null                    #   defaults; per-launch
+      num_pages: null                    #   overrides otherwise
+      max_len: null
+      max_tokens: null                   # default new-token budget
+      eos: null                          # default stop token id
+      stream_chunk_tokens: null          # tokens per streamed chunk
 
 ``queue: tcp://...`` points every host's worker at one TcpQueueServer
 broker -- the cross-host data plane (the reference's Redis role): run N
@@ -88,12 +105,22 @@ _M_DRAIN = get_registry().histogram(
 
 
 class ServingApp:
-    """A running serving deployment: model + worker + optional HTTP."""
+    """A running serving deployment: model + worker + optional HTTP.
 
-    def __init__(self, model: InferenceModel, worker: ServingWorker,
+    With a ``generation:`` config block the deployment also (or, when
+    ``model:`` is omitted, *only*) hosts a
+    :class:`~analytics_zoo_tpu.serving.generation.worker.GenerationWorker`
+    -- same supervisor, drain, chaos and fleet seams as the predict
+    worker, one frontend serving both ``/predict`` and ``/generate``.
+    """
+
+    def __init__(self, model: Optional[InferenceModel],
+                 worker: Optional[ServingWorker],
                  input_queue: InputQueue, output_queue: OutputQueue,
                  frontend: Optional[HttpFrontend],
-                 redis_frontend=None, reporter=None, supervisor=None):
+                 redis_frontend=None, reporter=None, supervisor=None,
+                 gen_worker=None, gen_supervisor=None,
+                 gen_input_queue=None):
         self.model = model
         self.worker = worker
         self.input_queue = input_queue
@@ -102,6 +129,9 @@ class ServingApp:
         self.redis_frontend = redis_frontend
         self.reporter = reporter
         self.supervisor = supervisor
+        self.gen_worker = gen_worker
+        self.gen_supervisor = gen_supervisor
+        self.gen_input_queue = gen_input_queue
 
     @property
     def address(self) -> Optional[str]:
@@ -118,16 +148,28 @@ class ServingApp:
                 "zoo.serving.drain.deadline_ms", 10000.0))
         emit_event("drain_begin", "serving", deadline_ms=deadline_ms)
         t0 = time.monotonic()
-        # supervisor first: a draining worker's thread exits with its
+        # supervisors first: a draining worker's thread exits with its
         # stop event unset, which must not read as a crash to restart
         if self.supervisor is not None:
             self.supervisor.stop()
+        if self.gen_supervisor is not None:
+            self.gen_supervisor.stop()
         if self.frontend is not None:
             # health goes 503 "draining" -> the fleet router (and any
             # LB honoring /healthz) stops sending traffic here; new
             # direct /predicts get a structured 503 + Retry-After
             self.frontend.set_draining()
-        ok = self.worker.drain(deadline_s=deadline_ms / 1000.0)
+        ok = True
+        if self.worker is not None:
+            ok = self.worker.drain(deadline_s=deadline_ms / 1000.0)
+        if self.gen_worker is not None:
+            # in-flight token STREAMS finish too: the generation drain
+            # admits nothing new and steps until every live slot
+            # reached its terminal chunk (each plane gets the full
+            # budget -- they drain concurrently-started work, not a
+            # shared quantity)
+            ok = self.gen_worker.drain(
+                deadline_s=deadline_ms / 1000.0) and ok
         waited = time.monotonic() - t0
         _M_DRAIN.observe(waited)
         emit_event("drain_complete", "serving", ok=ok,
@@ -139,15 +181,20 @@ class ServingApp:
         return ok
 
     def stop(self) -> None:
-        # supervisor FIRST: it exists to restart a stopping worker,
+        # supervisors FIRST: they exist to restart a stopping worker,
         # which is exactly what an orderly shutdown must not fight
         if self.supervisor is not None:
             self.supervisor.stop()
+        if self.gen_supervisor is not None:
+            self.gen_supervisor.stop()
         if self.frontend is not None:
             self.frontend.stop()
         if self.redis_frontend is not None:
             self.redis_frontend.stop()
-        self.worker.stop()
+        if self.worker is not None:
+            self.worker.stop()
+        if self.gen_worker is not None:
+            self.gen_worker.stop()
         if self.reporter is not None:
             self.reporter.stop()
         emit_event("serving_stop", "serving")
@@ -191,7 +238,16 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     from analytics_zoo_tpu.serving.chaos import maybe_install_from_config
 
     maybe_install_from_config()
-    model = _load_model(config)
+    # generation block (ISSUE-10): presence enables the token-
+    # streaming data plane (unless `enabled: false`); a deployment may
+    # host generation ONLY, in which case model.path is not required
+    gen_cfg = dict(config.get("generation") or {})
+    # PRESENCE of the block enables the plane (a bare `generation:`
+    # with every sub-key defaulted is valid), `enabled: false` opts out
+    gen_enabled = ("generation" in config
+                   and bool(gen_cfg.get("enabled", True)))
+    model = (None if gen_enabled and not config.get("model")
+             else _load_model(config))
     data = config.get("data") or {}
     params = config.get("params") or {}
     http = config.get("http") or {}
@@ -223,8 +279,9 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     from analytics_zoo_tpu.inference.sharded import (
         maybe_shard_from_config)
 
-    shard_plan = maybe_shard_from_config(model,
-                                         overrides=shard_overrides)
+    shard_plan = (maybe_shard_from_config(model,
+                                          overrides=shard_overrides)
+                  if model is not None else None)
 
     if data.get("queue") == "dir" and not data.get("path"):
         raise ValueError('data.queue "dir" needs data.path')
@@ -301,45 +358,111 @@ def launch(config: Dict[str, Any]) -> ServingApp:
         out_q = OutputQueue(backend=queue_kind,
                             path=(data.get("path") + ".out"
                                   if data.get("path") else None))
-    worker = ServingWorker(
-        model, in_q, out_q, batch_size=params.get("batch_size"),
-        timeout_ms=params.get("timeout_ms"),
-        top_n=params.get("top_n"),
-        pipeline_depth=params.get("pipeline_depth"),
-        pipelined=params.get("pipelined"),
-        min_timeout_ms=params.get("min_timeout_ms"),
-        max_batch_size=params.get("max_batch_size"))
-    from analytics_zoo_tpu.inference.inference_model import bucket_ladder
-
-    # default: every power-of-two bucket the batcher can emit -- up to
-    # its backlog GROWTH cap, not just the base size -- so no request
-    # ever pays a live XLA compile, least of all at the first backlog
-    # spike (exactly when a multi-second compile stall hurts most).
-    # Cap growth-warming with params.max_batch_size for deployments
-    # that cannot afford the extra startup compiles.
-    warm_cap = getattr(worker.batcher, "max_batch_size",
-                       worker.batcher.batch_size)
-    warm = params.get("warm_batch_sizes", bucket_ladder(warm_cap))
-    if warm:
-        warm_example = params.get("warm_example", model.example_input)
-        if warm_example is not None:
-            model.warm_up(warm_example, batch_sizes=tuple(warm))
-        else:
-            logger.warning(
-                "warm_batch_sizes set but no example input is "
-                "available; skipping warm-up")
-    worker.start()
+    supervise = bool(
+        get_config().get("zoo.serving.supervisor.enabled", True))
+    worker = None
     supervisor = None
-    if bool(get_config().get("zoo.serving.supervisor.enabled", True)):
-        # the recovery story (ISSUE-5): restart a dead/wedged worker
-        # with backoff, re-queue its in-flight requests exactly once
-        from analytics_zoo_tpu.serving.resilience import Supervisor
+    if model is not None:
+        worker = ServingWorker(
+            model, in_q, out_q, batch_size=params.get("batch_size"),
+            timeout_ms=params.get("timeout_ms"),
+            top_n=params.get("top_n"),
+            pipeline_depth=params.get("pipeline_depth"),
+            pipelined=params.get("pipelined"),
+            min_timeout_ms=params.get("min_timeout_ms"),
+            max_batch_size=params.get("max_batch_size"))
+        from analytics_zoo_tpu.inference.inference_model import (
+            bucket_ladder)
 
-        supervisor = Supervisor(worker).start()
+        # default: every power-of-two bucket the batcher can emit --
+        # up to its backlog GROWTH cap, not just the base size -- so
+        # no request ever pays a live XLA compile, least of all at the
+        # first backlog spike (exactly when a multi-second compile
+        # stall hurts most). Cap growth-warming with
+        # params.max_batch_size for deployments that cannot afford the
+        # extra startup compiles.
+        warm_cap = getattr(worker.batcher, "max_batch_size",
+                           worker.batcher.batch_size)
+        warm = params.get("warm_batch_sizes", bucket_ladder(warm_cap))
+        if warm:
+            warm_example = params.get("warm_example",
+                                      model.example_input)
+            if warm_example is not None:
+                model.warm_up(warm_example, batch_sizes=tuple(warm))
+            else:
+                logger.warning(
+                    "warm_batch_sizes set but no example input is "
+                    "available; skipping warm-up")
+        worker.start()
+        if supervise:
+            # the recovery story (ISSUE-5): restart a dead/wedged
+            # worker with backoff, re-queue its in-flight requests
+            # exactly once
+            from analytics_zoo_tpu.serving.resilience import Supervisor
+
+            supervisor = Supervisor(worker).start()
+    gen_worker = None
+    gen_supervisor = None
+    gen_in = None
     frontend = None
     redis_fe = None
     reporter = None
     try:
+        if gen_enabled:
+            # generation data plane (ISSUE-10): its OWN request
+            # stream (brokered backends shard it across fleet
+            # replicas through the same consumer group as the predict
+            # stream), the shared default result stream, and the same
+            # supervisor/drain machinery as the predict worker
+            from analytics_zoo_tpu.serving.generation.engine import (
+                engine_from_config)
+            from analytics_zoo_tpu.serving.generation.worker import (
+                GenerationWorker)
+
+            gen_stream = str(gen_cfg.get("stream", "generation_stream"))
+            if isinstance(queue_kind, str) and (
+                    queue_kind.startswith("tcp://")
+                    or queue_kind.startswith("redis://")):
+                if queue_kind.startswith("redis://"):
+                    gen_in = InputQueue(
+                        backend=queue_kind, name=gen_stream,
+                        group=str(data.get("group", "serving")),
+                        consumer=str(data.get("consumer")
+                                     or f"replica-{os.getpid()}"))
+                else:
+                    gen_in = InputQueue(backend=queue_kind,
+                                        name=gen_stream)
+                # chunks route back to THIS frontend's reply stream,
+                # exactly like predict results
+                gen_in.reply_stream = in_q.reply_stream
+            elif data.get("queue") == "dir" and data.get("path"):
+                # cross-process spool deployments keep their contract:
+                # a sibling spool directory, so external producers can
+                # enqueue generate requests the same way they enqueue
+                # predicts (a silent in-memory fallback would strand
+                # them with no consumer)
+                gen_in = InputQueue(backend="dir",
+                                    path=str(data["path"]) + ".gen",
+                                    maxlen=data.get("maxlen", 10000))
+            else:
+                gen_in = InputQueue(backend="memory",
+                                    maxlen=data.get("maxlen", 10000))
+            engine = engine_from_config(gen_cfg)
+            # the generate path's warm-up contract: compile the whole
+            # prefill ladder + the decode step before traffic, so a
+            # launch mints zero storm-eligible compiles
+            engine.warm_up()
+            gen_worker = GenerationWorker(
+                engine, gen_in, out_q,
+                max_tokens=gen_cfg.get("max_tokens"),
+                eos=gen_cfg.get("eos"),
+                stream_chunk_tokens=gen_cfg.get(
+                    "stream_chunk_tokens")).start()
+            if supervise:
+                from analytics_zoo_tpu.serving.resilience import (
+                    Supervisor)
+
+                gen_supervisor = Supervisor(gen_worker).start()
         if http.get("enabled", True):
             port = http.get("port")
             if port is None:
@@ -352,7 +475,8 @@ def launch(config: Dict[str, Any]) -> ServingApp:
                 host=http.get("host", "127.0.0.1"),
                 port=port, worker=worker,
                 certfile=http.get("certfile"),
-                keyfile=http.get("keyfile")).start()
+                keyfile=http.get("keyfile"),
+                gen_queue=gen_in, gen_worker=gen_worker).start()
             logger.info("serving ready at %s", frontend.address)
         redis_cfg = config.get("redis") or {}
         if redis_cfg.get("enabled"):
@@ -386,26 +510,35 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     except Exception as e:
         emit_event("launch_failed", "serving", error=repr(e)[:500])
         # no ServingApp handle escapes; don't leak running pieces
-        # (supervisor first, or it would restart the worker we stop)
+        # (supervisors first, or they would restart the workers we
+        # stop)
         if supervisor is not None:
             supervisor.stop()
+        if gen_supervisor is not None:
+            gen_supervisor.stop()
         if frontend is not None:
             frontend.stop()
         if redis_fe is not None:
             redis_fe.stop()
-        worker.stop()
+        if worker is not None:
+            worker.stop()
+        if gen_worker is not None:
+            gen_worker.stop()
         raise
     emit_event(
         "serving_launch", "serving",
         queue=str(data.get("queue") or "memory"),
-        pipelined=worker.pipelined,
+        pipelined=worker.pipelined if worker is not None else False,
         http=bool(http.get("enabled", True)),
         shard_mode=(shard_plan.label if shard_plan is not None
                     else "off"),
+        generation=gen_worker is not None,
         address=frontend.address if frontend is not None else None)
     return ServingApp(model, worker, in_q, out_q, frontend,
                       redis_frontend=redis_fe, reporter=reporter,
-                      supervisor=supervisor)
+                      supervisor=supervisor, gen_worker=gen_worker,
+                      gen_supervisor=gen_supervisor,
+                      gen_input_queue=gen_in)
 
 
 def launch_from_yaml(path: str) -> ServingApp:

@@ -26,6 +26,10 @@ can map it to an HTTP status without a second wire field):
   server fault)
 - ``circuit_open`` -> 503 (breaker fast-fail; the handler adds
   Retry-After to every 503 so clients back off)
+- ``generation_overflow`` -> 503 (KV-cache admission refusal;
+  transient, retryable)
+- ``invalid_request`` -> 400 (malformed client content the worker,
+  not the frontend, detected)
 
 ``ERROR_PREFIXES`` is the complete prefix -> HTTP-status contract;
 zoolint's ``error-prefix-unmapped`` rule fails any declared prefix
@@ -42,11 +46,26 @@ REPLY_KEY = "__reply__"
 TRACE_KEY = "__trace__"
 DEADLINE_KEY = "__deadline__"
 ERROR_KEY = "__error__"
+# generation serving (ISSUE-10). Request side: MAX_TOKENS_KEY caps the
+# new tokens a generate request may emit and EOS_KEY names its stop
+# token id (-1 = none) -- both ride the request blob next to
+# __deadline__. Reply side: STREAM_KEY is the monotonically increasing
+# chunk sequence number of a streamed generation reply; its PRESENCE
+# is what routes a blob into a stream mailbox instead of the one-shot
+# result path, and its value is the client's exactly-once dedup key (a
+# supervisor-restarted stream regenerates deterministically from chunk
+# 0, so consumers drop seq <= last-seen and never double-count a
+# token).
+STREAM_KEY = "__stream__"
+MAX_TOKENS_KEY = "__max_tokens__"
+EOS_KEY = "__eos__"
 
 # request-side out-of-band keys the decoder strips from tensor dicts
-# (ERROR_KEY is reply-side only: model outputs named "error" stay
-# usable, and an error reply is recognised by ERROR_KEY's presence)
-WIRE_KEYS = (URI_KEY, REPLY_KEY, TRACE_KEY, DEADLINE_KEY)
+# (ERROR_KEY/STREAM_KEY are reply-side only: model outputs named
+# "error" stay usable, and an error reply is recognised by ERROR_KEY's
+# presence, a stream chunk by STREAM_KEY's)
+WIRE_KEYS = (URI_KEY, REPLY_KEY, TRACE_KEY, DEADLINE_KEY,
+             MAX_TOKENS_KEY, EOS_KEY)
 
 # ------------------------------------------------------ error prefixes --
 DEADLINE_PREFIX = "deadline_exceeded"
@@ -58,6 +77,16 @@ CIRCUIT_PREFIX = "circuit_open"
 # so both map to 503 (every 503 carries Retry-After)
 DRAINING_PREFIX = "draining"
 REPLICA_PREFIX = "replica_unavailable"
+# generation vocabulary (ISSUE-10): a generate request refused at
+# admission because the paged KV cache has no free slot/pages left --
+# transient by construction (slots free as streams finish), so 503 +
+# Retry-After, never a generic 500
+GENERATION_PREFIX = "generation_overflow"
+# a request the worker could not honor because the CLIENT sent
+# malformed content past the frontend's shape checks (out-of-vocab
+# token ids, missing prompt tensor): 400, not 500 -- bad input must
+# never read as a server fault on the error-rate dashboard
+INVALID_PREFIX = "invalid_request"
 
 # prefix -> HTTP status the frontend answers with; prefixes absent
 # here fall through to 500 (generic server fault), which is exactly
@@ -67,6 +96,8 @@ ERROR_PREFIXES = {
     CIRCUIT_PREFIX: 503,
     DRAINING_PREFIX: 503,
     REPLICA_PREFIX: 503,
+    GENERATION_PREFIX: 503,
+    INVALID_PREFIX: 400,
 }
 
 

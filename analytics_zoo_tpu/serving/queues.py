@@ -28,7 +28,8 @@ from analytics_zoo_tpu.obs import tracing as _tracing
 from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.obs.metrics import get_registry as _get_registry
 from analytics_zoo_tpu.serving.protocol import (
-    DEADLINE_KEY, REPLY_KEY, TRACE_KEY, URI_KEY, WIRE_KEYS)
+    DEADLINE_KEY, EOS_KEY, MAX_TOKENS_KEY, REPLY_KEY, TRACE_KEY,
+    URI_KEY, WIRE_KEYS)
 
 # client-side data-plane counters (the queues' entry in the unified
 # registry): offered load, backpressure rejections, drained results
@@ -61,7 +62,9 @@ _ZIP_MAGIC = b"PK"  # np.savez container (legacy v1 blobs)
 def _encode(uri: str, payload: Dict[str, np.ndarray],
             reply_to: Optional[str] = None,
             trace_id: Optional[str] = None,
-            deadline: Optional[float] = None) -> bytes:
+            deadline: Optional[float] = None,
+            max_tokens: Optional[int] = None,
+            eos: Optional[int] = None) -> bytes:
     items = [(URI_KEY, np.asarray(uri))]
     if reply_to:
         # reply-to stream for brokered deployments: the worker that
@@ -72,6 +75,14 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
         # end-to-end tracing (obs.tracing): the id rides the blob so
         # worker stages can span against it; absent when tracing is off
         items.append((TRACE_KEY, np.asarray(trace_id)))
+    if max_tokens is not None:
+        # generation budget (ISSUE-10): the worker stops the stream
+        # after this many new tokens (absent on predict requests)
+        items.append((MAX_TOKENS_KEY,
+                      np.asarray(int(max_tokens), np.int32)))
+    if eos is not None:
+        # generation stop token id (-1 = none)
+        items.append((EOS_KEY, np.asarray(int(eos), np.int32)))
     if deadline is not None:
         # absolute epoch-seconds deadline (zoo.serving.deadline_ms,
         # stamped at enqueue): the worker rejects expired requests at
@@ -155,34 +166,61 @@ def _decode_traced(blob: bytes) -> Tuple[str, Dict[str, np.ndarray],
     return uri, tensors, reply, trace
 
 
+def _decode_to_dict(blob: bytes) -> Dict[str, np.ndarray]:
+    """Framing dispatch, THE one place the blob container format is
+    recognized: AZT1 raw-buffer framing, or the legacy np.savez (zip)
+    container -- both -> {name: array}. Every decoder (predict,
+    generation) goes through here, so a future framing change has one
+    home."""
+    if blob[:4] == _MAGIC:
+        return _decode_raw(blob)
+    if not blob.startswith(_ZIP_MAGIC):
+        raise ValueError("not a serving wire blob (neither AZT1 nor "
+                         "legacy npz framing)")
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:  # legacy v1
+        return {k: z[k] for k in z.files}
+
+
+def _request_meta(z: Dict[str, np.ndarray]
+                  ) -> Tuple[str, Optional[str], Optional[str],
+                             Optional[float]]:
+    """(uri, reply_to, trace_id, deadline) out of a decoded blob dict
+    -- the meta keys every request carries regardless of data plane."""
+    uri = str(z[URI_KEY].reshape(())) if URI_KEY in z else ""
+    reply = str(z[REPLY_KEY].reshape(())) if REPLY_KEY in z else None
+    trace = str(z[TRACE_KEY].reshape(())) if TRACE_KEY in z else None
+    deadline = (float(z[DEADLINE_KEY].reshape(()))
+                if DEADLINE_KEY in z else None)
+    return uri, reply, trace, deadline
+
+
 def _decode_request(blob: bytes
                     ) -> Tuple[str, Dict[str, np.ndarray],
                                Optional[str], Optional[str],
                                Optional[float]]:
     """The worker's decode: (uri, tensors, reply_to, trace_id,
     deadline) with every meta key stripped from the tensor dict."""
-    if blob[:4] == _MAGIC:
-        z = _decode_raw(blob)
-        uri = str(z[URI_KEY].reshape(())) if URI_KEY in z else ""
-        reply = (str(z[REPLY_KEY].reshape(()))
-                 if REPLY_KEY in z else None)
-        trace = (str(z[TRACE_KEY].reshape(()))
-                 if TRACE_KEY in z else None)
-        deadline = (float(z[DEADLINE_KEY].reshape(()))
-                    if DEADLINE_KEY in z else None)
-        return uri, {k: v for k, v in z.items()
-                     if k not in _META_KEYS}, reply, trace, deadline
-    if not blob.startswith(_ZIP_MAGIC):
-        raise ValueError("not a serving wire blob (neither AZT1 nor "
-                         "legacy npz framing)")
-    with np.load(io.BytesIO(blob), allow_pickle=False) as z:  # legacy v1
-        uri = str(z[URI_KEY])
-        reply = str(z[REPLY_KEY]) if REPLY_KEY in z.files else None
-        trace = str(z[TRACE_KEY]) if TRACE_KEY in z.files else None
-        deadline = (float(z[DEADLINE_KEY])
-                    if DEADLINE_KEY in z.files else None)
-        return uri, {k: z[k] for k in z.files
-                     if k not in _META_KEYS}, reply, trace, deadline
+    z = _decode_to_dict(blob)
+    uri, reply, trace, deadline = _request_meta(z)
+    return uri, {k: v for k, v in z.items()
+                 if k not in _META_KEYS}, reply, trace, deadline
+
+
+def _decode_generation(blob: bytes
+                       ) -> Tuple[str, Dict[str, np.ndarray],
+                                  Optional[str], Optional[str],
+                                  Optional[float], Optional[int],
+                                  Optional[int]]:
+    """The generation worker's decode: ``_decode_request``'s 5-tuple
+    plus ``(max_tokens, eos)`` (None when the request omitted them --
+    the worker falls back to the ``zoo.generation.*`` defaults)."""
+    z = _decode_to_dict(blob)
+    uri, reply, trace, deadline = _request_meta(z)
+    max_tokens = (int(z[MAX_TOKENS_KEY].reshape(()))
+                  if MAX_TOKENS_KEY in z else None)
+    eos = int(z[EOS_KEY].reshape(())) if EOS_KEY in z else None
+    tensors = {k: v for k, v in z.items() if k not in _META_KEYS}
+    return uri, tensors, reply, trace, deadline, max_tokens, eos
 
 
 class MemQueue:
@@ -626,6 +664,28 @@ class InputQueue:
             emit_event("request_shed", "serving", depth=depth,
                        shed_depth=self.shed_depth)
         return True
+
+    def enqueue_generation(self, uri: str, tokens,
+                           max_tokens: Optional[int] = None,
+                           eos: Optional[int] = None) -> bool:
+        """Enqueue a *generate* request (ISSUE-10): ``tokens`` is the
+        1-D int prompt; ``max_tokens``/``eos`` ride the blob as
+        reserved wire keys next to the deadline. Same admission
+        control / shedding / False-means-refused contract as
+        :meth:`enqueue`."""
+        if self.shed_depth and self._shed():
+            return False
+        deadline = (time.time() + self.deadline_ms / 1000.0
+                    if self.deadline_ms else None)
+        ok = self._q.put(_encode(
+            uri, {"tokens": np.asarray(tokens, np.int32).reshape(-1)},
+            reply_to=self.reply_stream,
+            trace_id=_tracing.current_trace_id(),
+            deadline=deadline, max_tokens=max_tokens, eos=eos))
+        _M_ENQ.inc()
+        if not ok:
+            _M_ENQ_REJECTED.inc()
+        return ok
 
     def enqueue_image(self, uri: str, data, key: str = "image") -> bool:
         """Enqueue a COMPRESSED image (JPEG/PNG file path or bytes);

@@ -12,7 +12,10 @@
 #     scripts/check_tree.sh --soak       # lint + a CI-sized fleet chaos
 #                                        # soak (2 replica processes, one
 #                                        # SIGKILL, rolling restart; ~2
-#                                        # min) -- the exactly-once gate
+#                                        # min) -- the exactly-once gate --
+#                                        # plus the generation soak smoke
+#                                        # (60 overlapping token streams,
+#                                        # exact + exactly-once + A/B)
 #
 # Any other arguments are forwarded to scripts/zoolint.py.
 set -euo pipefail
@@ -36,4 +39,6 @@ python -m pytest tests/test_zoolint.py tests/test_metric_names.py \
 if [ "$SOAK" = 1 ]; then
     echo "== fleet chaos soak (smoke) =="
     python scripts/fleet_soak.py --smoke
+    echo "== generation soak (smoke) =="
+    python scripts/perf_generation.py --smoke
 fi

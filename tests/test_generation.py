@@ -1,0 +1,760 @@
+"""Generation serving tests (ISSUE-10).
+
+Covers the prefill/decode split end to end: paged KV cache accounting
+(slot reuse, exhaustion, refusal), greedy-decode parity vs the
+unbatched reference model, continuous-batch join/leave (a request
+admitted mid-decode produces identical tokens to solo decode), the
+streamed-response frontend contract (chunk framing, trace id,
+mid-stream deadline), drain finishing in-flight streams, supervisor
+restart exactly-once via chunk-seq dedup, and the seq2seq satellite
+(device-side greedy loop vs the legacy host loop).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.inference.kv_cache import (CacheOverflow,
+                                                  PagedKVCache)
+from analytics_zoo_tpu.serving import chaos
+from analytics_zoo_tpu.serving.generation.engine import (
+    DecodeEngine, prefill_ladder)
+from analytics_zoo_tpu.serving.generation.model import (
+    GenModelConfig, TinyGenLM)
+from analytics_zoo_tpu.serving.generation.worker import GenerationWorker
+from analytics_zoo_tpu.serving.protocol import (
+    DEADLINE_PREFIX, ERROR_KEY, ERROR_PREFIXES, GENERATION_PREFIX,
+    STREAM_KEY, error_status)
+from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
+
+TINY = GenModelConfig(vocab=32, dim=16, heads=2, head_dim=8, layers=2,
+                      max_len=64, seed=0)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    return TinyGenLM(TINY)
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_lm):
+    """One warmed engine shared by the pure-engine tests (they release
+    every slot they take; greedy decode is deterministic, so sharing
+    is safe)."""
+    return DecodeEngine(tiny_lm, num_slots=4, page_size=4,
+                        max_len=64).warm_up()
+
+
+def _drain_stream(out_q, uris, timeout=30.0):
+    """Collect chunk streams for ``uris`` from an OutputQueue:
+    {uri: {"toks": [...], "seqs": [...], "reason"|"error": ...}}."""
+    got = {u: {"toks": [], "seqs": []} for u in uris}
+    done = set()
+    deadline = time.time() + timeout
+    while len(done) < len(uris) and time.time() < deadline:
+        item = out_q.dequeue(timeout=0.2)
+        if item is None:
+            continue
+        uri, tensors = item
+        if uri not in got:
+            continue
+        assert STREAM_KEY in tensors
+        seq = int(np.asarray(tensors[STREAM_KEY]).reshape(()))
+        rec = got[uri]
+        if ERROR_KEY in tensors:
+            rec["error"] = str(np.asarray(
+                tensors[ERROR_KEY]).reshape(()))
+            assert seq == -1  # error terminals are never dedupable
+            done.add(uri)
+            continue
+        rec["seqs"].append(seq)
+        if "token" in tensors:
+            rec["toks"].extend(
+                int(t) for t in np.asarray(tensors["token"]).reshape(-1))
+        if "finish_reason" in tensors:
+            rec["reason"] = str(np.asarray(
+                tensors["finish_reason"]).reshape(()))
+            rec["n_tokens"] = int(np.asarray(
+                tensors["n_tokens"]).reshape(()))
+            done.add(uri)
+    assert len(done) == len(uris), f"incomplete streams: {got}"
+    return got
+
+
+# ------------------------------------------------------------------ #
+# paged KV cache                                                     #
+# ------------------------------------------------------------------ #
+
+class TestPagedKVCache:
+    def _cache(self, **kw):
+        kw.setdefault("num_layers", 1)
+        kw.setdefault("num_heads", 1)
+        kw.setdefault("head_dim", 4)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("max_len", 16)
+        return PagedKVCache(**kw)
+
+    def test_pages_for(self):
+        c = self._cache()
+        assert c.pages_for(1) == 1
+        assert c.pages_for(4) == 1
+        assert c.pages_for(5) == 2
+        assert c.pages_for(16) == 4
+
+    def test_admit_reserves_worst_case(self):
+        c = self._cache(num_pages=4)  # 2 slots x 16 tokens won't fit
+        s = c.admit(3, 9)  # 12 tokens -> 3 pages reserved
+        assert c.can_admit(4) is True     # 1 page left
+        assert c.can_admit(5) is False    # would need 2
+        with pytest.raises(CacheOverflow):
+            c.admit(5, 3)
+        c.release(s)
+        assert c.can_admit(16)
+
+    def test_lazy_assignment_and_growth(self):
+        c = self._cache(num_pages=8)
+        s = c.admit(3, 9)
+        assert c.utilization() == 0.0  # reserved, nothing assigned
+        c.ensure_length(s, 3)
+        assert list(c.block_tables()[s] > 0) == [True] + [False] * 3
+        c.ensure_length(s, 5)  # crosses a page boundary
+        assert (c.block_tables()[s] > 0).sum() == 2
+        assert c.lengths()[s] == 5
+        with pytest.raises(ValueError):
+            c.ensure_length(s, 13)  # past the 12-token reservation
+
+    def test_release_recycles_pages(self):
+        c = self._cache(num_pages=4)
+        s = c.admit(4, 4)
+        c.ensure_length(s, 8)
+        used = set(int(p) for p in c.block_tables()[s] if p)
+        assert len(used) == 2
+        c.release(s)
+        c.release(s)  # idempotent
+        assert c.utilization() == 0.0
+        s2 = c.admit(8, 8)
+        c.ensure_length(s2, 16)
+        reused = set(int(p) for p in c.block_tables()[s2] if p)
+        # block reuse: the freed pages are handed out again
+        assert used <= reused
+
+    def test_slot_exhaustion(self):
+        c = self._cache()
+        c.admit(1, 1)
+        c.admit(1, 1)
+        with pytest.raises(CacheOverflow):
+            c.admit(1, 1)
+
+    def test_max_len_refused(self):
+        c = self._cache()
+        with pytest.raises(CacheOverflow):
+            c.admit(10, 10)  # 20 > max_len 16
+
+
+# ------------------------------------------------------------------ #
+# decode engine                                                      #
+# ------------------------------------------------------------------ #
+
+class TestDecodeEngine:
+    def test_prefill_ladder_page_aligned(self):
+        assert prefill_ladder(4, 64) == [4, 8, 16, 32, 64]
+        assert prefill_ladder(16, 100) == [16, 32, 64, 128]
+
+    def test_greedy_parity_vs_reference(self, tiny_lm, engine):
+        rng = np.random.RandomState(42)
+        for _ in range(3):
+            prompt = rng.randint(0, TINY.vocab,
+                                 rng.randint(2, 12)).astype(np.int32)
+            ref = tiny_lm.reference_generate(engine.params, prompt, 12)
+            slot, tok0 = engine.admit(prompt, 12)
+            toks = [tok0]
+            while len(toks) < 12:
+                toks.append(dict(engine.step())[slot])
+            engine.release(slot)
+            assert toks == list(ref)
+
+    def test_continuous_join_leave_token_exact(self, tiny_lm, engine):
+        """A request admitted mid-decode produces the same tokens as
+        solo decode -- the continuous batcher's correctness contract."""
+        pa = np.array([5, 6, 7], np.int32)
+        pb = np.array([1, 2, 3, 4, 5, 6], np.int32)
+        pc = np.array([30, 2, 19, 11], np.int32)
+        refs = {u: tiny_lm.reference_generate(engine.params, p, n)
+                for u, (p, n) in
+                {"a": (pa, 10), "b": (pb, 8), "c": (pc, 6)}.items()}
+        sa, t0a = engine.admit(pa, 10)
+        out = {"a": [t0a], "b": [], "c": []}
+        for _ in range(3):  # a runs alone for a few steps
+            for s, t in engine.step():
+                out["a"].append(t)
+        sb, t0b = engine.admit(pb, 8)   # b joins mid-decode
+        out["b"].append(t0b)
+        for _ in range(2):
+            for s, t in engine.step():
+                {sa: out["a"], sb: out["b"]}[s].append(t)
+        sc, t0c = engine.admit(pc, 6)   # c joins later still
+        out["c"].append(t0c)
+        slots = {sa: "a", sb: "b", sc: "c"}
+        want = {"a": 10, "b": 8, "c": 6}
+        while any(len(out[u]) < want[u] for u in out):
+            for s, t in engine.step():
+                u = slots[s]
+                if len(out[u]) < want[u]:
+                    out[u].append(t)
+                if len(out[u]) >= want[u] and s in engine._active:
+                    engine.release(s)  # leave mid-flight of others
+        for u in out:
+            assert out[u] == list(refs[u]), u
+
+    def test_overflow_refusal_then_reuse(self, tiny_lm):
+        eng = DecodeEngine(tiny_lm, num_slots=2, page_size=4,
+                           max_len=16, num_pages=4).warm_up()
+        s0, _ = eng.admit(np.array([1, 2, 3], np.int32), 9)  # 3 pages
+        with pytest.raises(CacheOverflow):
+            eng.admit(np.array([1, 2, 3, 4, 5], np.int32), 3)
+        eng.release(s0)
+        s1, _ = eng.admit(np.array([1, 2, 3, 4, 5], np.int32), 3)
+        assert s1 in (0, 1)
+
+    def test_admit_failure_releases_slot(self, tiny_lm):
+        """A post-claim failure (prefill bug, poisoned request) must
+        give the slot + reservation back -- a leak here is a
+        remotely-triggerable capacity DoS."""
+        eng = DecodeEngine(tiny_lm, num_slots=2, page_size=4,
+                           max_len=16).warm_up()
+
+        def boom(*a, **k):
+            raise RuntimeError("injected prefill failure")
+
+        real = eng._prefill_jit
+        eng._prefill_jit = boom
+        try:
+            for _ in range(4):  # more failures than slots
+                with pytest.raises(RuntimeError):
+                    eng.admit(np.array([1, 2], np.int32), 4)
+        finally:
+            eng._prefill_jit = real
+        assert eng.free_slots() == 2
+        assert eng.cache.stats()["pages_reserved_unassigned"] == 0
+        # the engine still serves after the failures
+        slot, _ = eng.admit(np.array([1, 2], np.int32), 4)
+        eng.release(slot)
+
+    def test_admit_rejects_nonpositive_budget(self, tiny_lm, engine):
+        with pytest.raises(ValueError):
+            engine.admit(np.array([1, 2], np.int32), 0)
+        assert engine.free_slots() == 4
+
+    def test_warm_up_compiles_everything(self, tiny_lm):
+        """After warm_up, admissions/steps mint no live compiles (the
+        zero-storm acceptance requirement)."""
+        from analytics_zoo_tpu.obs.events import get_event_log
+
+        eng = DecodeEngine(tiny_lm, num_slots=2, page_size=4,
+                           max_len=16).warm_up()
+        log = get_event_log()
+        before = len([e for e in log.tail(2048, type="compile")
+                      if e["fields"]["fn"].startswith("generation.")
+                      and not e["fields"]["warm"]])
+        slot, _ = eng.admit(np.array([4, 9, 2, 7, 1], np.int32), 8)
+        for _ in range(7):
+            eng.step()
+        eng.release(slot)
+        after = len([e for e in log.tail(2048, type="compile")
+                     if e["fields"]["fn"].startswith("generation.")
+                     and not e["fields"]["warm"]])
+        assert after == before
+        storms = [e for e in log.tail(2048, type="recompile_storm")
+                  if e["subsystem"] == "generation"]
+        assert storms == []
+
+
+# ------------------------------------------------------------------ #
+# generation worker                                                  #
+# ------------------------------------------------------------------ #
+
+class TestGenerationWorker:
+    def _worker(self, tiny_lm, **eng_kw):
+        eng_kw.setdefault("num_slots", 4)
+        eng_kw.setdefault("page_size", 4)
+        eng_kw.setdefault("max_len", 64)
+        eng = DecodeEngine(tiny_lm, **eng_kw).warm_up()
+        in_q = InputQueue(backend="memory")
+        out_q = OutputQueue(backend="memory")
+        return GenerationWorker(eng, in_q, out_q), in_q, out_q
+
+    def test_e2e_exactly_once_token_exact(self, tiny_lm):
+        w, in_q, out_q = self._worker(tiny_lm)
+        rng = np.random.RandomState(7)
+        prompts = {}
+        for i in range(9):  # 9 overlapping streams over 4 slots
+            p = rng.randint(0, TINY.vocab,
+                            rng.randint(2, 10)).astype(np.int32)
+            prompts[f"r{i}"] = p
+            assert in_q.enqueue_generation(f"r{i}", p, max_tokens=10)
+        w.start()
+        try:
+            got = _drain_stream(out_q, list(prompts))
+        finally:
+            w.stop()
+        for uri, rec in got.items():
+            # exactly-once: contiguous chunk seqs, no dupes/gaps
+            assert rec["seqs"] == list(range(len(rec["seqs"])))
+            ref = tiny_lm.reference_generate(w.engine.params,
+                                             prompts[uri], 10)
+            assert rec["toks"] == list(ref), uri
+            assert rec["reason"] == "length"
+            assert rec["n_tokens"] == 10
+        assert w.served == 9
+        # every slot and page back on the free lists
+        stats = w.engine.cache.stats()
+        assert stats["slots_free"] == 4
+        assert stats["pages_assigned"] == 0
+
+    def test_eos_stops_stream(self, tiny_lm):
+        w, in_q, out_q = self._worker(tiny_lm)
+        prompt = np.array([3, 7, 1, 9, 2], np.int32)
+        ref = tiny_lm.reference_generate(w.engine.params, prompt, 20)
+        eos = int(ref[3])  # stop on the 4th generated token
+        in_q.enqueue_generation("e", prompt, max_tokens=20, eos=eos)
+        w.start()
+        try:
+            got = _drain_stream(out_q, ["e"])
+        finally:
+            w.stop()
+        assert got["e"]["reason"] == "stop"
+        assert got["e"]["toks"][-1] == eos
+        assert got["e"]["toks"] == [int(t) for t in ref[:4]]
+
+    def test_overflow_refusal_structured_503(self, tiny_lm):
+        # 2 slots but pages for only one worst-case stream at a time
+        w, in_q, out_q = self._worker(tiny_lm, num_slots=2,
+                                      max_len=32, num_pages=8)
+        in_q.enqueue_generation("big", np.arange(2, 10, dtype=np.int32),
+                                max_tokens=24)  # 32 tokens = 8 pages
+        in_q.enqueue_generation("refused",
+                                np.arange(1, 9, dtype=np.int32),
+                                max_tokens=24)
+        w.start()
+        try:
+            got = _drain_stream(out_q, ["big", "refused"])
+        finally:
+            w.stop()
+        assert got["big"]["reason"] == "length"
+        err = got["refused"]["error"]
+        assert err.startswith(GENERATION_PREFIX)
+        assert error_status(err) == 503
+        assert ERROR_PREFIXES[GENERATION_PREFIX] == 503
+
+    def test_out_of_vocab_prompt_structured_400(self, tiny_lm):
+        """Malformed client content the frontend can't pre-check maps
+        to invalid_request -> 400, never a generic 500, and leaks no
+        slot."""
+        from analytics_zoo_tpu.serving.protocol import INVALID_PREFIX
+
+        w, in_q, out_q = self._worker(tiny_lm)
+        in_q.enqueue_generation(
+            "bad", np.array([0, 9999], np.int32), max_tokens=4)
+        w.start()
+        try:
+            got = _drain_stream(out_q, ["bad"])
+        finally:
+            w.stop()
+        err = got["bad"]["error"]
+        assert err.startswith(INVALID_PREFIX)
+        assert error_status(err) == 400
+        assert w.engine.free_slots() == 4
+
+    def test_drain_finishes_inflight_streams(self, tiny_lm):
+        w, in_q, out_q = self._worker(tiny_lm)
+        in_q.enqueue_generation("d", np.array([4, 5], np.int32),
+                                max_tokens=40)
+        w.start()
+        # wait for the stream to be live, then drain
+        deadline = time.time() + 10
+        while not w._streams and time.time() < deadline:
+            time.sleep(0.01)
+        assert w._streams
+        assert w.drain(deadline_s=20.0) is True
+        got = _drain_stream(out_q, ["d"], timeout=5.0)
+        assert got["d"]["reason"] == "length"
+        assert got["d"]["n_tokens"] == 40
+        # drained worker admits nothing new
+        in_q.enqueue_generation("late", np.array([1], np.int32),
+                                max_tokens=2)
+        time.sleep(0.2)
+        assert out_q.dequeue(timeout=0.2) is None
+
+    def test_midstream_deadline_structured_terminal(self, tiny_lm):
+        """Wire deadline expiring mid-decode -> the stream ends with a
+        structured deadline_exceeded terminal chunk, not silence."""
+        w, _, out_q = self._worker(tiny_lm)
+        in_q = InputQueue(queue=w._in, deadline_ms=400.0)
+        chaos.install(chaos.ChaosInjector(chaos.parse_spec(
+            "sleep:dispatch:every=1:dur=0.12")))
+        try:
+            in_q.enqueue_generation("slow", np.array([3, 1], np.int32),
+                                    max_tokens=50)
+            w.start()
+            got = _drain_stream(out_q, ["slow"], timeout=15.0)
+        finally:
+            chaos.uninstall()
+            w.stop()
+        err = got["slow"]["error"]
+        assert err.startswith(DEADLINE_PREFIX)
+        # some tokens streamed before the budget ran out
+        assert 0 < len(got["slow"]["toks"]) < 50
+
+    def test_supervisor_restart_replays_exactly_once(self, tiny_lm):
+        """Crash mid-stream -> supervisor requeues -> deterministic
+        regeneration; chunk-seq dedup makes delivery exactly-once."""
+        from analytics_zoo_tpu.serving.resilience import Supervisor
+
+        w, in_q, out_q = self._worker(tiny_lm)
+        sup = Supervisor(w, poll_interval_s=0.05,
+                         heartbeat_timeout_s=30.0,
+                         backoff_base_s=0.01, backoff_max_s=0.05)
+        chaos.install(chaos.ChaosInjector(chaos.parse_spec(
+            "crash:dispatch:at=4")))
+        prompt = np.array([9, 8, 7], np.int32)
+        ref = tiny_lm.reference_generate(w.engine.params, prompt, 12)
+        try:
+            in_q.enqueue_generation("x", prompt, max_tokens=12)
+            w.start()
+            sup.start()
+            # collect with seq dedup (the frontend's contract)
+            toks, last_seq = [], -1
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                item = out_q.dequeue(timeout=0.2)
+                if item is None:
+                    continue
+                uri, tensors = item
+                seq = int(np.asarray(tensors[STREAM_KEY]).reshape(()))
+                assert ERROR_KEY not in tensors, tensors
+                if seq <= last_seq:
+                    continue  # replayed chunk after restart
+                last_seq = seq
+                toks.extend(int(t) for t in
+                            np.asarray(tensors["token"]).reshape(-1))
+                if "finish_reason" in tensors:
+                    break
+            assert toks == list(ref)
+            assert w.served >= 1
+        finally:
+            chaos.uninstall()
+            sup.stop()
+            w.stop()
+
+
+# ------------------------------------------------------------------ #
+# HTTP /generate                                                     #
+# ------------------------------------------------------------------ #
+
+@pytest.fixture(scope="module")
+def gen_app():
+    from analytics_zoo_tpu.serving.launcher import launch
+
+    app = launch({
+        "generation": {
+            "enabled": True,
+            "model": {"vocab": 32, "dim": 16, "heads": 2,
+                      "head_dim": 8, "layers": 2, "seed": 0},
+            "slots": 4, "page_size": 4, "max_len": 64,
+        },
+        "http": {"enabled": True},
+    })
+    yield app
+    app.stop()
+
+
+def _sse_events(addr, body, timeout=30):
+    req = urllib.request.Request(
+        addr + "/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    events = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        assert resp.status == 200
+        assert resp.headers.get("Content-Type") == "text/event-stream"
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if line.startswith(b"data: "):
+                events.append(json.loads(line[6:]))
+    return events
+
+
+class TestHttpGenerate:
+    def test_stream_contract(self, gen_app, tiny_lm):
+        events = _sse_events(gen_app.address,
+                             {"prompt": [3, 7, 1, 9, 2],
+                              "max_tokens": 8})
+        assert "uri" in events[0]  # meta event leads the stream
+        data = [e for e in events if "seq" in e]
+        assert [e["seq"] for e in data] == list(range(len(data)))
+        assert data[-1]["finish_reason"] == "length"
+        assert data[-1]["n_tokens"] == 8
+        toks = [t for e in data for t in e.get("token", [])]
+        ref = tiny_lm.reference_generate(
+            gen_app.gen_worker.engine.params,
+            np.array([3, 7, 1, 9, 2], np.int32), 8)
+        assert toks == list(ref)
+
+    def test_stream_carries_trace_id(self, gen_app):
+        get_config().set("zoo.obs.trace.enabled", True)
+        try:
+            events = _sse_events(gen_app.address,
+                                 {"prompt": [1, 2], "max_tokens": 2})
+        finally:
+            get_config().unset("zoo.obs.trace.enabled")
+        assert events[0].get("trace_id")
+
+    def test_nonstream_collects(self, gen_app):
+        req = urllib.request.Request(
+            gen_app.address + "/generate",
+            data=json.dumps({"prompt": [5, 6], "max_tokens": 4,
+                             "stream": False}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        assert len(out["tokens"]) == 4
+        assert out["finish_reason"] == "length"
+
+    def test_bad_requests(self, gen_app):
+        for body, want in (({"prompt": []}, 400),
+                           ({"prompt": "abc"}, 400),
+                           ({"prompt": [1], "max_tokens": "x"}, 400),
+                           ({"prompt": [1], "max_tokens": 0}, 400),
+                           ({}, 400)):
+            req = urllib.request.Request(
+                gen_app.address + "/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.read()
+            assert code == want, body
+
+    def test_generate_404_when_not_enabled(self):
+        """A predict-only frontend answers /generate with 404."""
+        from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
+
+        in_q = InputQueue(backend="memory")
+        out_q = OutputQueue(backend="memory")
+        fe = HttpFrontend(in_q, out_q).start()
+        try:
+            req = urllib.request.Request(
+                fe.address + "/generate",
+                data=json.dumps({"prompt": [1]}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.read()
+            assert code == 404
+        finally:
+            fe.stop()
+
+    def test_draining_refuses_503(self, gen_app):
+        gen_app.frontend.set_draining()
+        try:
+            req = urllib.request.Request(
+                gen_app.address + "/generate",
+                data=json.dumps({"prompt": [1]}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    code, payload = resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                code = e.code
+                payload = json.loads(e.read())
+                assert e.headers.get("Retry-After")
+            assert code == 503
+            assert payload["error"] == "draining"
+        finally:
+            gen_app.frontend._draining = False
+
+    def test_frontend_stall_emits_structured_terminal(self, tiny_lm):
+        """Chunks stalling past request_timeout (an inter-chunk stall
+        detector, NOT a total-stream budget -- that's the wire
+        deadline's job) emit the structured deadline_exceeded terminal
+        event instead of a silent close."""
+        from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
+
+        eng = DecodeEngine(tiny_lm, num_slots=2, page_size=4,
+                           max_len=64).warm_up()
+        in_q = InputQueue(backend="memory")
+        out_q = OutputQueue(backend="memory")
+        w = GenerationWorker(eng, in_q, out_q)
+        fe = HttpFrontend(InputQueue(backend="memory"), out_q,
+                          request_timeout=0.4, gen_queue=in_q,
+                          gen_worker=w).start()
+        chaos.install(chaos.ChaosInjector(chaos.parse_spec(
+            "sleep:dispatch:every=4:dur=0.9")))
+        w.start()
+        try:
+            events = _sse_events(fe.address,
+                                 {"prompt": [2, 4], "max_tokens": 60},
+                                 timeout=15)
+        finally:
+            chaos.uninstall()
+            fe.stop()
+            w.stop()
+        assert events[-1].get("error") == DEADLINE_PREFIX
+        assert DEADLINE_PREFIX in events[-1]["detail"]
+        # chunks flowed before the stall
+        assert any("token" in e for e in events)
+
+
+class TestFleetGenerateRelay:
+    def test_router_streams_generate_through(self, gen_app, tiny_lm):
+        """The front-tier fleet router relays /generate chunk streams
+        verbatim from a healthy replica."""
+        from analytics_zoo_tpu.serving.fleet import FleetRouter
+
+        class _Rep:
+            name = "r0"
+            address = gen_app.address
+
+        class _Stub:
+            def pick_replica(self, exclude=()):
+                return None if "r0" in exclude else _Rep()
+
+            def mark_unhealthy(self, rep, reason):
+                pass
+
+            def replica_states(self):
+                return {"healthy": 1}
+
+            def stats(self):
+                return {}
+
+        router = FleetRouter(_Stub(), retries=1).start()
+        try:
+            events = _sse_events(router.address,
+                                 {"prompt": [3, 7, 1, 9, 2],
+                                  "max_tokens": 6})
+        finally:
+            router.stop()
+        data = [e for e in events if "seq" in e]
+        assert data[-1]["finish_reason"] == "length"
+        toks = [t for e in data for t in e.get("token", [])]
+        ref = tiny_lm.reference_generate(
+            gen_app.gen_worker.engine.params,
+            np.array([3, 7, 1, 9, 2], np.int32), 6)
+        assert toks == list(ref)
+
+    def test_router_503_when_no_replica(self):
+        from analytics_zoo_tpu.serving.fleet import FleetRouter
+
+        class _Stub:
+            def pick_replica(self, exclude=()):
+                return None
+
+            def mark_unhealthy(self, rep, reason):
+                pass
+
+            def replica_states(self):
+                return {"healthy": 0}
+
+            def stats(self):
+                return {}
+
+        router = FleetRouter(_Stub(), retries=0).start()
+        try:
+            req = urllib.request.Request(
+                router.address + "/generate",
+                data=json.dumps({"prompt": [1]}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    code, payload = resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                code, payload = e.code, json.loads(e.read())
+            assert code == 503
+            assert payload["error"] == "replica_unavailable"
+        finally:
+            router.stop()
+
+
+# ------------------------------------------------------------------ #
+# seq2seq satellite                                                  #
+# ------------------------------------------------------------------ #
+
+class TestSeq2seqDeviceLoop:
+    def test_scan_matches_host_loop(self):
+        from analytics_zoo_tpu.models.seq2seq import Seq2seq
+
+        m = Seq2seq(vocab=20, embed_dim=16, hidden_sizes=(16,),
+                    max_len=10)
+        src = np.random.RandomState(0).randint(
+            1, 20, (3, 6)).astype(np.int32)
+        fast = m.infer(src, start_id=1)
+        legacy = m.infer(src, start_id=1, host_loop=True)
+        np.testing.assert_array_equal(fast, legacy)
+
+    def test_scan_matches_host_loop_dense_bridge(self):
+        from analytics_zoo_tpu.models.seq2seq import Seq2seq
+
+        m = Seq2seq(vocab=12, embed_dim=8, hidden_sizes=(8, 8),
+                    bridge="dense", max_len=7)
+        src = np.random.RandomState(1).randint(
+            1, 12, (2, 4)).astype(np.int32)
+        np.testing.assert_array_equal(
+            m.infer(src, 2), m.infer(src, 2, host_loop=True))
+
+    def test_one_dispatch_not_per_token(self):
+        """The device-side loop must not dispatch per token: count
+        module.apply-level jit executions via a traced wrapper."""
+        from analytics_zoo_tpu.models.seq2seq import Seq2seq
+
+        m = Seq2seq(vocab=10, embed_dim=8, hidden_sizes=(8,),
+                    max_len=8)
+        src = np.ones((1, 3), np.int32)
+        m.infer(src, 1)  # build + compile
+        fns = m.__dict__["_infer_fns"]
+        assert set(fns) == {8}  # one cached program per max_len
+        m.infer(src, 1, max_len=5)
+        assert set(fns) == {8, 5}
+
+
+# ------------------------------------------------------------------ #
+# protocol contract                                                  #
+# ------------------------------------------------------------------ #
+
+class TestGenerationProtocol:
+    def test_prefix_registered_and_mapped(self):
+        assert GENERATION_PREFIX in ERROR_PREFIXES
+        assert ERROR_PREFIXES[GENERATION_PREFIX] == 503
+        assert error_status(f"{GENERATION_PREFIX}: kv cache "
+                            "exhausted") == 503
+
+    def test_wire_roundtrip_generation_keys(self):
+        from analytics_zoo_tpu.serving.queues import (
+            _decode_generation, _encode)
+
+        blob = _encode("u1", {"tokens": np.arange(4, dtype=np.int32)},
+                       max_tokens=9, eos=3, deadline=123.5)
+        uri, tensors, reply, trace, deadline, mt, eos = \
+            _decode_generation(blob)
+        assert uri == "u1"
+        assert list(tensors) == ["tokens"]
+        assert (mt, eos, deadline) == (9, 3, 123.5)
+        # predict-path decode strips the generation keys from tensors
+        from analytics_zoo_tpu.serving.queues import _decode_request
+
+        _, t2, _, _, _ = _decode_request(blob)
+        assert list(t2) == ["tokens"]
