@@ -45,8 +45,20 @@ review time:
                      hot-path host syncs (hotpath-block-on-device),
                      and dtype drift (dtype-upcast-f32,
                      dtype-mixed-collective)
+- ``cfg``            engine #4's substrate: per-function control-flow
+                     graphs with exception edges, duplicated
+                     finally/with unwinds, and bounded path
+                     enumeration
+- ``lifecycle_rules``path-sensitive resource-lifecycle + exactly-
+                     once-reply checks over the CFG (leak-on-path,
+                     double-release, release-unacquired,
+                     cleanup-not-in-finally, reply-missing-on-path,
+                     reply-duplicated-on-path) -- the static twin of
+                     the serving ledger, with one interprocedural
+                     level of acquire/release through helpers
 
-Entry points: ``scripts/zoolint.py`` (CLI, baseline-aware, ``--json``)
+Entry points: ``scripts/zoolint.py`` (CLI, baseline-aware, ``--json``
+/ ``--format sarif`` / ``--profile``)
 and ``tests/test_zoolint.py`` (tier-1 gate). Findings suppress inline
 with ``# zoolint: disable=<rule>`` on the offending or preceding line;
 grandfathered findings live in ``zoolint_baseline.json`` with a
@@ -68,17 +80,31 @@ from analytics_zoo_tpu.analysis.baseline import (  # noqa: F401
     new_findings,
     write_baseline,
 )
+from analytics_zoo_tpu.analysis.cfg import (  # noqa: F401
+    CFG,
+    build_cfg,
+    iter_paths,
+)
+from analytics_zoo_tpu.analysis.lifecycle_rules import (  # noqa: F401
+    LifecycleChecker,
+    ResourceSpec,
+)
 
 __all__ = [
+    "CFG",
     "Checker",
     "Finding",
+    "LifecycleChecker",
     "Project",
+    "ResourceSpec",
     "SourceFile",
     "all_checkers",
     "all_rules",
-    "register",
-    "run_zoolint",
+    "build_cfg",
+    "iter_paths",
     "load_baseline",
     "new_findings",
+    "register",
+    "run_zoolint",
     "write_baseline",
 ]

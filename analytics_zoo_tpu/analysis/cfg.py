@@ -1,0 +1,495 @@
+"""Per-function control-flow graphs: the substrate of engine #4.
+
+The first three zoolint engines (AST rules, dataflow, call graph) are
+all path-*insensitive*: they see that a release call exists, not
+whether every path from the acquire reaches it.  This module builds a
+CFG per function -- branches, loops, try/except/finally, with-blocks,
+early return/raise/break/continue, and *exception edges* -- so
+``lifecycle_rules`` can walk paths and prove pairing properties the
+runtime ledger can only enforce dynamically.
+
+Model (chosen for lint-scale precision, documented in
+docs/zoolint.md):
+
+- One :class:`Node` per simple statement, plus synthetic nodes:
+  ``entry``, ``exit`` (normal completion), ``raise-exit`` (an
+  exception left the function), ``branch``/``loop`` headers,
+  ``except`` handler entries, ``finally``/``with-exit`` unwind
+  anchors.
+- Edges are ``(successor, label)`` with labels ``next``, ``true``,
+  ``false``, ``back`` (loop back edge), ``return``, ``break``,
+  ``raise`` (explicit), ``exc`` (unwind continuation), ``case``, and
+  ``mayraise`` -- the *implicit* exception edge added for statements
+  the ``may_raise`` predicate accepts (default: contains a call).
+  On a ``mayraise``/``raise`` edge the statement's effects have NOT
+  happened -- walkers must propagate the pre-state.
+- ``finally`` bodies (and ``with`` unwinds) are **duplicated** per
+  crossing kind -- one copy on the normal path, one per abrupt jump
+  (return/break/continue) that crosses them, and one shared copy for
+  the exception unwind.  Sharing a single copy would merge paths that
+  continue to different places and fabricate infeasible routes; at
+  lint scale the duplication is cheap and exact.  A node-count cap
+  (``max_nodes``) makes pathological nesting degrade to "no CFG"
+  (conservative: callers skip the function) rather than blow up.
+- ``iter_paths`` enumerates complete entry-to-exit paths taking each
+  *edge* at most once -- every loop contributes its zero-iteration
+  and one-iteration paths, which is exactly the precision the
+  lifecycle rules need (a leak that needs two iterations to manifest
+  also manifests in one).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterator, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Node", "CFG", "build_cfg", "default_may_raise",
+           "iter_paths"]
+
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                  ast.Lambda)
+
+
+def _calls_in(node: ast.AST) -> bool:
+    """True when ``node`` contains a Call that executes *here* --
+    nested def/class/lambda bodies run later (or never) and are
+    pruned."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.Call):
+            return True
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, _NESTED_SCOPES):
+                continue
+            stack.append(child)
+    return False
+
+
+def default_may_raise(stmt: ast.stmt) -> bool:
+    """Default implicit-exception predicate: a statement that calls
+    anything may raise.  Asserts always may (AssertionError).  Walkers
+    with domain knowledge (lifecycle: a bare registered release call
+    is exempt, or exception paths would flag the cleanup itself) pass
+    their own predicate to :func:`build_cfg`."""
+    if isinstance(stmt, ast.Assert):
+        return True
+    return _calls_in(stmt)
+
+
+class Node:
+    """One CFG node. ``stmt`` is the owning AST statement (None for
+    entry/exit), ``kind`` one of: entry, exit, raise-exit, stmt,
+    raise, branch, loop, except, with, with-exit, finally."""
+
+    __slots__ = ("stmt", "kind", "idx", "succ")
+
+    def __init__(self, stmt: Optional[ast.AST], kind: str, idx: int):
+        self.stmt = stmt
+        self.kind = kind
+        self.idx = idx
+        self.succ: List[Tuple["Node", str]] = []
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<cfg {self.kind}#{self.idx} L{self.line}>"
+
+
+class CFG:
+    """The built graph for one function."""
+
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.name = getattr(func, "name", "<lambda>")
+        self.nodes: List[Node] = []
+        self.entry = self._new_node(None, "entry")
+        self.exit = self._new_node(None, "exit")
+        self.raise_exit = self._new_node(None, "raise-exit")
+
+    def _new_node(self, stmt: Optional[ast.AST], kind: str) -> Node:
+        node = Node(stmt, kind, len(self.nodes))
+        self.nodes.append(node)
+        return node
+
+
+class _Overflow(Exception):
+    pass
+
+
+class _LoopFrame:
+    __slots__ = ("header", "breaks")
+
+    def __init__(self, header: Node):
+        self.header = header
+        self.breaks: List[Tuple[Node, str]] = []
+
+
+class _TryFrame:
+    __slots__ = ("handlers", "catch_all")
+
+    def __init__(self, handlers: List[Node], catch_all: bool):
+        self.handlers = handlers
+        self.catch_all = catch_all
+
+
+class _FinallyFrame:
+    """A ``finally`` body (or a ``with`` __exit__) every route out of
+    the guarded region must run.  ``_unwind`` caches the one shared
+    exception-unwind copy."""
+
+    __slots__ = ("body", "anchor", "is_with", "_unwind")
+
+    def __init__(self, body: Optional[Sequence[ast.stmt]],
+                 anchor: ast.stmt, is_with: bool = False):
+        self.body = body
+        self.anchor = anchor
+        self.is_with = is_with
+        self._unwind: Optional[Node] = None
+
+
+def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:`` or Exception/BaseException (incl. inside a
+    tuple) stops outward exception propagation."""
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("Exception",
+                                                "BaseException"):
+            return True
+    return False
+
+
+# frontier: list of (node, label) dangling edges awaiting their target
+_Frontier = List[Tuple[Node, str]]
+
+
+class _Builder:
+    def __init__(self, cfg: CFG,
+                 may_raise: Callable[[ast.stmt], bool],
+                 max_nodes: int):
+        self.cfg = cfg
+        self.may_raise = may_raise
+        self.max_nodes = max_nodes
+
+    def new(self, stmt: Optional[ast.AST], kind: str = "stmt") -> Node:
+        if len(self.cfg.nodes) >= self.max_nodes:
+            raise _Overflow
+        return self.cfg._new_node(stmt, kind)
+
+    @staticmethod
+    def connect(frontier: _Frontier, target: Node) -> None:
+        for node, label in frontier:
+            node.succ.append((target, label))
+
+    # ------------------------------------------------------- driver --
+    def build(self) -> None:
+        frontier = self.stmts(self.cfg.func.body,
+                              [(self.cfg.entry, "next")], [])
+        self.connect(frontier, self.cfg.exit)
+
+    def stmts(self, body: Sequence[ast.stmt], frontier: _Frontier,
+              stack: list) -> _Frontier:
+        for s in body:
+            frontier = self.stmt(s, frontier, stack)
+        return frontier
+
+    # -------------------------------------------- exception routing --
+    def _exc_targets(self, stack: list) -> List[Node]:
+        """Where an exception raised under ``stack`` goes first:
+        every reachable handler entry, then (unless a catch-all
+        stops it) the nearest finally unwind or raise-exit."""
+        targets: List[Node] = []
+        for i in range(len(stack) - 1, -1, -1):
+            fr = stack[i]
+            if isinstance(fr, _FinallyFrame):
+                targets.append(self._unwind_entry(fr, stack[:i]))
+                return targets
+            if isinstance(fr, _TryFrame):
+                targets.extend(fr.handlers)
+                if fr.catch_all:
+                    return targets
+        targets.append(self.cfg.raise_exit)
+        return targets
+
+    def _unwind_entry(self, fr: _FinallyFrame, outer: list) -> Node:
+        """The shared exception-path copy of a finally/with unwind:
+        run the body, then keep propagating outward."""
+        if fr._unwind is not None:
+            return fr._unwind
+        if fr.is_with:
+            head = self.new(fr.anchor, "with-exit")
+            fr._unwind = head
+            tail: _Frontier = [(head, "next")]
+        else:
+            head = self.new(fr.anchor, "finally")
+            fr._unwind = head
+            tail = self.stmts(fr.body, [(head, "next")], list(outer))
+        targets = self._exc_targets(outer)
+        for node, _label in tail:
+            for target in targets:
+                node.succ.append((target, "exc"))
+        return head
+
+    def _add_exc_edges(self, node: Node, stack: list,
+                       label: str) -> None:
+        for target in self._exc_targets(stack):
+            node.succ.append((target, label))
+
+    def _route_through_finallys(self, frontier: _Frontier, stack: list,
+                                stop_index: int) -> _Frontier:
+        """Build fresh finally copies for every _FinallyFrame in
+        ``stack[stop_index+1:]``, innermost first -- the path an
+        abrupt jump (return/break/continue) takes."""
+        for i in range(len(stack) - 1, stop_index, -1):
+            fr = stack[i]
+            if isinstance(fr, _FinallyFrame):
+                frontier = self._finally_copy(fr, frontier, stack[:i])
+        return frontier
+
+    def _finally_copy(self, fr: _FinallyFrame, frontier: _Frontier,
+                      outer: list) -> _Frontier:
+        if fr.is_with:
+            node = self.new(fr.anchor, "with-exit")
+            self.connect(frontier, node)
+            return [(node, "next")]
+        head = self.new(fr.anchor, "finally")
+        self.connect(frontier, head)
+        return self.stmts(fr.body, [(head, "next")], list(outer))
+
+    # ---------------------------------------------------- dispatch --
+    def stmt(self, s: ast.stmt, frontier: _Frontier,
+             stack: list) -> _Frontier:
+        if isinstance(s, ast.If):
+            return self._if(s, frontier, stack)
+        if isinstance(s, ast.While):
+            return self._while(s, frontier, stack)
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            return self._for(s, frontier, stack)
+        if isinstance(s, ast.Try) or (hasattr(ast, "TryStar")
+                                      and isinstance(s, ast.TryStar)):
+            return self._try(s, frontier, stack)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            return self._with(s, frontier, stack)
+        if isinstance(s, ast.Return):
+            return self._return(s, frontier, stack)
+        if isinstance(s, ast.Raise):
+            return self._raise(s, frontier, stack)
+        if isinstance(s, ast.Break):
+            return self._break(s, frontier, stack)
+        if isinstance(s, ast.Continue):
+            return self._continue(s, frontier, stack)
+        if hasattr(ast, "Match") and isinstance(s, ast.Match):
+            return self._match(s, frontier, stack)
+        node = self.new(s, "stmt")
+        self.connect(frontier, node)
+        if not isinstance(s, _NESTED_SCOPES) and self.may_raise(s):
+            self._add_exc_edges(node, stack, "mayraise")
+        return [(node, "next")]
+
+    def _if(self, s: ast.If, frontier: _Frontier,
+            stack: list) -> _Frontier:
+        node = self.new(s, "branch")
+        self.connect(frontier, node)
+        if _calls_in(s.test):
+            self._add_exc_edges(node, stack, "mayraise")
+        out = self.stmts(s.body, [(node, "true")], stack)
+        if s.orelse:
+            out = out + self.stmts(s.orelse, [(node, "false")], stack)
+        else:
+            out = out + [(node, "false")]
+        return out
+
+    def _while(self, s: ast.While, frontier: _Frontier,
+               stack: list) -> _Frontier:
+        header = self.new(s, "loop")
+        self.connect(frontier, header)
+        if _calls_in(s.test):
+            self._add_exc_edges(header, stack, "mayraise")
+        lf = _LoopFrame(header)
+        body = self.stmts(s.body, [(header, "true")], stack + [lf])
+        for node, _label in body:
+            node.succ.append((header, "back"))
+        out: _Frontier = []
+        # ``while True:`` has no normal exit edge -- only breaks leave
+        always = (isinstance(s.test, ast.Constant) and bool(s.test.value))
+        if not always:
+            if s.orelse:
+                out += self.stmts(s.orelse, [(header, "false")], stack)
+            else:
+                out += [(header, "false")]
+        return out + lf.breaks
+
+    def _for(self, s, frontier: _Frontier, stack: list) -> _Frontier:
+        header = self.new(s, "loop")
+        self.connect(frontier, header)
+        if _calls_in(s.iter):
+            self._add_exc_edges(header, stack, "mayraise")
+        lf = _LoopFrame(header)
+        body = self.stmts(s.body, [(header, "true")], stack + [lf])
+        for node, _label in body:
+            node.succ.append((header, "back"))
+        out: _Frontier = []
+        if s.orelse:
+            out += self.stmts(s.orelse, [(header, "false")], stack)
+        else:
+            out += [(header, "false")]
+        return out + lf.breaks
+
+    def _try(self, s, frontier: _Frontier, stack: list) -> _Frontier:
+        fin: Optional[_FinallyFrame] = None
+        stack_f = stack
+        if s.finalbody:
+            fin = _FinallyFrame(s.finalbody, s)
+            stack_f = stack + [fin]
+        entries: List[Node] = []
+        catch_all = False
+        for h in s.handlers:
+            entries.append(self.new(h, "except"))
+            catch_all = catch_all or _is_catch_all(h)
+        if s.handlers:
+            tf = _TryFrame(entries, catch_all)
+            out = self.stmts(s.body, frontier, stack_f + [tf])
+        else:
+            out = self.stmts(s.body, frontier, stack_f)
+        if s.orelse:  # runs only on clean try body; its exceptions
+            out = self.stmts(s.orelse, out, stack_f)  # skip handlers
+        for h, entry in zip(s.handlers, entries):
+            out = out + self.stmts(h.body, [(entry, "next")], stack_f)
+        if fin is not None:
+            out = self._finally_copy(fin, out, stack)
+        return out
+
+    def _with(self, s, frontier: _Frontier, stack: list) -> _Frontier:
+        node = self.new(s, "with")
+        self.connect(frontier, node)
+        if any(_calls_in(it.context_expr) for it in s.items):
+            # the context-manager expression can raise BEFORE the
+            # scope exists -- that edge bypasses __exit__
+            self._add_exc_edges(node, stack, "mayraise")
+        fr = _FinallyFrame(None, s, is_with=True)
+        body = self.stmts(s.body, [(node, "next")], stack + [fr])
+        exit_node = self.new(s, "with-exit")
+        self.connect(body, exit_node)
+        return [(exit_node, "next")]
+
+    def _return(self, s: ast.Return, frontier: _Frontier,
+                stack: list) -> _Frontier:
+        node = self.new(s, "stmt")
+        self.connect(frontier, node)
+        if self.may_raise(s):
+            self._add_exc_edges(node, stack, "mayraise")
+        out = self._route_through_finallys([(node, "return")],
+                                           stack, -1)
+        self.connect(out, self.cfg.exit)
+        return []
+
+    def _raise(self, s: ast.Raise, frontier: _Frontier,
+               stack: list) -> _Frontier:
+        node = self.new(s, "raise")
+        self.connect(frontier, node)
+        for target in self._exc_targets(stack):
+            node.succ.append((target, "raise"))
+        return []
+
+    def _loop_index(self, stack: list) -> int:
+        for i in range(len(stack) - 1, -1, -1):
+            if isinstance(stack[i], _LoopFrame):
+                return i
+        return -1
+
+    def _break(self, s, frontier: _Frontier, stack: list) -> _Frontier:
+        idx = self._loop_index(stack)
+        if idx < 0:  # syntactically invalid; degrade to a plain stmt
+            node = self.new(s, "stmt")
+            self.connect(frontier, node)
+            return [(node, "next")]
+        node = self.new(s, "stmt")
+        self.connect(frontier, node)
+        out = self._route_through_finallys([(node, "break")],
+                                           stack, idx)
+        stack[idx].breaks.extend(out)
+        return []
+
+    def _continue(self, s, frontier: _Frontier,
+                  stack: list) -> _Frontier:
+        idx = self._loop_index(stack)
+        if idx < 0:
+            node = self.new(s, "stmt")
+            self.connect(frontier, node)
+            return [(node, "next")]
+        node = self.new(s, "stmt")
+        self.connect(frontier, node)
+        out = self._route_through_finallys([(node, "next")],
+                                           stack, idx)
+        self.connect(out, stack[idx].header)
+        # label fix: edges into the header from a continue are back
+        # edges; connect() wrote them with their carried labels, which
+        # is fine for walkers (the header is the loop node either way)
+        return []
+
+    def _match(self, s, frontier: _Frontier, stack: list) -> _Frontier:
+        node = self.new(s, "branch")
+        self.connect(frontier, node)
+        out: _Frontier = [(node, "false")]  # no case matched
+        for case in s.cases:
+            out += self.stmts(case.body, [(node, "case")], stack)
+        return out
+
+
+def build_cfg(func: ast.AST,
+              may_raise: Optional[Callable[[ast.stmt], bool]] = None,
+              max_nodes: int = 4000) -> Optional[CFG]:
+    """Build the CFG for one FunctionDef/AsyncFunctionDef.  Returns
+    None when the function exceeds ``max_nodes`` (pathological
+    nesting): callers must treat that as "no knowledge", never as
+    "clean" -- conservative, like every engine here."""
+    if may_raise is None:
+        may_raise = default_may_raise
+    cfg = CFG(func)
+    builder = _Builder(cfg, may_raise, max_nodes)
+    try:
+        builder.build()
+    except _Overflow:
+        return None
+    except RecursionError:  # pragma: no cover - absurd nesting
+        return None
+    return cfg
+
+
+def iter_paths(cfg: CFG, max_paths: int = 4096
+               ) -> Iterator[Tuple[Tuple[str, Node], ...]]:
+    """Enumerate complete paths from entry to exit/raise-exit as
+    tuples of (edge label, node).  Each *edge* is taken at most once
+    per path, so every loop yields its zero- and one-iteration
+    variants without unrolling.  Stops quietly after ``max_paths``
+    (callers needing to know use a counter and compare)."""
+    emitted = 0
+    path: List[Tuple[str, Node]] = []
+    used: Set[Tuple[int, int]] = set()
+
+    def walk(node: Node) -> Iterator[Tuple[Tuple[str, Node], ...]]:
+        nonlocal emitted
+        if emitted >= max_paths:
+            return
+        if node.kind in ("exit", "raise-exit"):
+            emitted += 1
+            yield tuple(path)
+            return
+        for pos, (nxt, label) in enumerate(node.succ):
+            key = (node.idx, pos)
+            if key in used:
+                continue
+            used.add(key)
+            path.append((label, nxt))
+            yield from walk(nxt)
+            path.pop()
+            used.discard(key)
+            if emitted >= max_paths:
+                return
+
+    yield from walk(cfg.entry)

@@ -28,6 +28,7 @@ import ast
 import dataclasses
 import os
 import re
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 SEVERITIES = ("error", "warning", "info")
@@ -204,8 +205,9 @@ def register(cls: type) -> type:
 def _load_builtin_checkers() -> None:
     # import for side effect: each module @register-s its checkers
     from analytics_zoo_tpu.analysis import (  # noqa: F401
-        concurrency, config_keys, deep_rules, hygiene, mesh_rules,
-        protocol, trace_hazards, vocabulary)
+        concurrency, config_keys, deep_rules, hygiene,
+        lifecycle_rules, mesh_rules, protocol, trace_hazards,
+        vocabulary)
 
 
 def all_checkers() -> List[Checker]:
@@ -281,7 +283,8 @@ def run_zoolint(paths: Sequence[str],
                 rules: Optional[Sequence[str]] = None,
                 checkers: Optional[Sequence[Checker]] = None,
                 repo_root: Optional[str] = None,
-                report_only: Optional[Sequence[str]] = None
+                report_only: Optional[Sequence[str]] = None,
+                timings: Optional[Dict[str, float]] = None
                 ) -> List[Finding]:
     """Run checkers over ``paths``; returns suppression-filtered
     findings sorted by (path, line, rule). ``rules`` restricts to a
@@ -291,9 +294,16 @@ def run_zoolint(paths: Sequence[str],
     path: the whole tree is still parsed -- project checkers need the
     cross-module ground truth (``_DEFAULTS``, vocabulary owners) to
     stay sound -- but per-file checkers run only on the listed files
-    and every finding outside them is dropped."""
+    and every finding outside them is dropped.
+
+    ``timings``, when given a dict, is filled with wall seconds per
+    checker family plus a ``"parse"`` entry (the one-parse cost every
+    family shares) -- the ``--profile`` surface."""
+    t0 = time.perf_counter()
     files, repo_root = collect_files(paths, repo_root=repo_root)
     project = Project(files, repo_root=repo_root)
+    if timings is not None:
+        timings["parse"] = time.perf_counter() - t0
     only_rel: Optional[Set[str]] = None
     if report_only is not None:
         only_rel = {
@@ -308,11 +318,15 @@ def run_zoolint(paths: Sequence[str],
         checkers = [c for c in checkers if wanted & set(c.rules)]
     findings: List[Finding] = []
     for checker in checkers:
+        t0 = time.perf_counter()
         for src in files:
             if only_rel is not None and src.rel not in only_rel:
                 continue
             findings.extend(checker.check_file(src))
         findings.extend(checker.check_project(project))
+        if timings is not None:
+            timings[checker.name] = (timings.get(checker.name, 0.0)
+                                     + time.perf_counter() - t0)
     kept = []
     for f in findings:
         if wanted is not None and f.rule not in wanted:

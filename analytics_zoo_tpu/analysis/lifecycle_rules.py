@@ -1,0 +1,999 @@
+"""Path-sensitive resource-lifecycle & exactly-once-reply rules
+(zoolint engine #4: "leakcheck").
+
+Built on :mod:`analysis.cfg` (per-function CFGs with implicit
+exception edges) and PR 8's call graph: a bounded product walk over
+(CFG node, abstract state) proves pairing properties on *every* path
+-- the static twin of the serving stack's runtime delivery ledger.
+
+Resource model (the declarative registry :data:`DEFAULT_SPECS`):
+
+- ``acquire`` call names bind a *token* to the assignment target(s)
+  (``bind="result"``), to the call's first argument (``bind="arg"``:
+  ``ledger.record(uri, ...)`` tracks ``uri``), or to the receiver
+  object (``bind="receiver"``: a bare ``lock.acquire()`` statement).
+- ``release`` call names settle the token, matched against an
+  argument (``release_on="arg"``) or the receiver
+  (``release_on="receiver"``: ``t.join()``). Release-name matching
+  ignores leading underscores so ``self._settle(uri)`` counts.
+- A token *transfers* (ownership leaves the function; no release owed
+  here) when it is returned, stored into an attribute or container
+  (``self._streams[slot] = stream``), passed to an unresolved call,
+  or passed to a resolved callee whose summary stores or returns it.
+  Acquire results consumed directly by ``return``, by another call,
+  or by a ``with`` item are born transferred/scoped: never tracked.
+- Conservative by construction: anything unresolvable (acquire in a
+  branch test -- the ``if not lock.acquire(blocking=False)`` idiom --
+  conditional results, receivers that are not dotted names, CFG
+  overflow) silently drops tracking. Unknown never becomes a finding.
+
+Exactly-once-reply: a module declares its stage methods with a
+module-level ``ZOOLINT_REPLY_OBLIGATED = ("Class.method", ...)``
+tuple (mirroring deepcheck's ``ZOOLINT_HOT_PATH``). Every declared
+method must reach at least one *resolution* -- a reply/error push, a
+settle/ack/requeue, or an ownership hand-off into an instance
+container -- on every normal-exit path (exception paths are exempt:
+the supervisor's crash requeue covers them), and at most one direct
+terminal push *site* on any single path. Duplicates are counted per
+call site, not per execution: a single push re-fired through a loop
+back edge is the per-batch reply loop (one reply per request), while
+two distinct push sites on one path mean one request answered twice.
+Entering a loop whose body resolves grants resolution: the
+zero-iteration path means zero pulled requests, which is vacuously
+settled.
+
+Interprocedural (one level plus a small fixpoint): per-function
+summaries record which parameters a callee releases or stores away
+and whether it pushes/settles; PR 8 call edges apply them at the call
+site, so ``self._push_error(uri, ...)`` settles ``uri`` because
+``_push_error`` itself calls ``self._settle(uri)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from analytics_zoo_tpu.analysis.callgraph import (
+    CallGraph, FnNode, build_call_graph, own_nodes)
+from analytics_zoo_tpu.analysis.cfg import (
+    CFG, Node, _NESTED_SCOPES, build_cfg)
+from analytics_zoo_tpu.analysis.core import (
+    Checker, Finding, Project, SourceFile, register)
+
+__all__ = ["ResourceSpec", "DEFAULT_SPECS", "LifecycleChecker",
+           "REPLY_DECL"]
+
+REPLY_DECL = "ZOOLINT_REPLY_OBLIGATED"
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceSpec:
+    """One acquire/release pairing the engine tracks.
+
+    ``exc_safe``: exception exits never owe a release (an external
+    mechanism -- the supervisor requeue -- covers crashes).
+    ``strict_release``: releasing twice / releasing unacquired is a
+    bug (False for idempotent releases: ledger settle, thread join).
+    ``daemon_exempt``: a ctor called with ``daemon=True`` is
+    untracked. ``ctor_roots``: dotted acquire calls must hang off one
+    of these root names (``threading.Thread``); bare names also match.
+    ``receiver_hints``: the acquire receiver's dotted path must
+    contain one of these parts (``self.ledger.record``)."""
+
+    name: str
+    describe: str
+    acquire: Tuple[str, ...]
+    release: Tuple[str, ...]
+    bind: str = "result"            # result | arg | receiver
+    release_on: str = "arg"         # arg | receiver
+    receiver_hints: Tuple[str, ...] = ()
+    ctor_roots: Optional[Tuple[str, ...]] = None
+    daemon_exempt: bool = False
+    exc_safe: bool = False
+    strict_release: bool = True
+
+
+DEFAULT_SPECS: Tuple[ResourceSpec, ...] = (
+    ResourceSpec(
+        name="kv-slot",
+        describe="KV-cache slot/page reservation",
+        acquire=("admit", "reserve"),
+        release=("release", "release_pages", "free"),
+        bind="result", release_on="arg"),
+    ResourceSpec(
+        name="ledger-entry",
+        describe="delivery-ledger entry",
+        acquire=("record",),
+        release=("settle", "ack", "ack_uris"),
+        bind="arg", release_on="arg",
+        receiver_hints=("ledger",),
+        exc_safe=True, strict_release=False),
+    ResourceSpec(
+        name="lock",
+        describe="lock",
+        acquire=("acquire",),
+        release=("release",),
+        bind="receiver", release_on="receiver"),
+    ResourceSpec(
+        name="thread",
+        describe="thread/process",
+        acquire=("Thread", "Process"),
+        release=("join", "stop", "terminate"),
+        bind="result", release_on="receiver",
+        ctor_roots=("threading", "multiprocessing", "mp"),
+        daemon_exempt=True, strict_release=False),
+    ResourceSpec(
+        name="warm-scope",
+        describe="warming scope",
+        acquire=("warming",),
+        release=(),
+        bind="result"),
+)
+
+# terminal reply pushes (exactly-once accounting); _push_chunk counts
+# only with an explicit final=True keyword
+_PUSH_NAMES = {"_push", "push", "_push_error", "push_error",
+               "_reply_error", "reply_error"}
+_PUSH_FINAL_NAMES = {"_push_chunk", "push_chunk"}
+# settlement verbs (matched after stripping leading underscores)
+_SETTLE_NAMES = {"settle", "ack", "ack_uris", "ack_input", "requeue"}
+# container hand-off methods on self-rooted receivers
+_HANDOFF_METHODS = {"append", "appendleft", "add", "put", "extend"}
+# calls that never take ownership of their arguments
+_PURE_BUILTINS = {
+    "len", "str", "int", "float", "bool", "repr", "min", "max",
+    "sorted", "list", "tuple", "dict", "set", "frozenset",
+    "isinstance", "issubclass", "getattr", "hasattr", "format",
+    "print", "id", "hash", "abs", "sum", "enumerate", "zip", "range",
+    "round", "divmod", "type"}
+_LOG_ROOTS = {"logger", "logging", "log"}
+
+_CLEANUP_CALL_NAMES = (_SETTLE_NAMES
+                       | {n.lstrip("_") for spec in DEFAULT_SPECS
+                          for n in spec.release})
+
+
+# ------------------------------------------------------------------ #
+# small AST helpers                                                   #
+# ------------------------------------------------------------------ #
+def _call_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _dotted(expr: ast.expr) -> Optional[str]:
+    """Render a Name/Attribute-of-Names chain ('self._writing'), or
+    None when the chain passes through anything else (a call, a
+    subscript): those receivers are untrackable."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _attr_root_name(expr: ast.expr) -> Optional[str]:
+    node = expr
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    """Name ids appearing in ``node``, pruning nested scopes."""
+    out: Set[str] = set()
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.Name):
+            out.add(cur.id)
+        for ch in ast.iter_child_nodes(cur):
+            if not isinstance(ch, _NESTED_SCOPES):
+                stack.append(ch)
+    return out
+
+
+def _target_names(target: ast.expr) -> List[str]:
+    """Name ids bound by an assignment target (flattening tuples);
+    empty when any element is not a plain Name."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in target.elts:
+            if not isinstance(e, ast.Name):
+                return []
+            out.append(e.id)
+        return out
+    return []
+
+
+def _kw_is_true(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True)
+    return False
+
+
+def _is_push_call(call: ast.Call) -> bool:
+    name = _call_name(call)
+    if name in _PUSH_NAMES:
+        return True
+    return name in _PUSH_FINAL_NAMES and _kw_is_true(call, "final")
+
+
+def _is_settle_call(call: ast.Call) -> bool:
+    name = _call_name(call)
+    return name is not None and name.lstrip("_") in _SETTLE_NAMES
+
+
+def _is_handoff_call(call: ast.Call) -> bool:
+    """self-rooted container mutation: ``self._inflight.append(rec)``
+    -- the record's ownership moved to instance state."""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in _HANDOFF_METHODS:
+        return False
+    return _attr_root_name(call.func.value) == "self"
+
+
+def _lifecycle_may_raise(stmt: ast.stmt,
+                         exempt_ids: frozenset = frozenset()) -> bool:
+    """Like ``default_may_raise`` but bare cleanup statements --
+    every call a registered release/settle verb, or (``exempt_ids``)
+    a resolved call into a helper whose summary releases a parameter
+    -- are exempt, or the canonical ``except: release(slot); raise``
+    handler and the ``self._fail(slot)`` cleanup-helper idiom would
+    themselves grow exception edges on which the release has not
+    happened."""
+    if isinstance(stmt, ast.Assert):
+        return True
+    calls: List[ast.Call] = []
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.Call):
+            calls.append(cur)
+        for ch in ast.iter_child_nodes(cur):
+            if not isinstance(ch, _NESTED_SCOPES):
+                stack.append(ch)
+    if not calls:
+        return False
+    for c in calls:
+        if id(c) in exempt_ids:
+            continue
+        name = _call_name(c)
+        if name is None or name.lstrip("_") not in _CLEANUP_CALL_NAMES:
+            return True
+    return False
+
+
+def _may_raise_for(fn: FnNode,
+                   summaries: Dict[FnNode, "_Summary"]):
+    """Per-function ``may_raise`` predicate: the module-wide cleanup
+    verbs plus this function's resolved release-helper call sites."""
+    exempt = set()
+    for e in fn.edges_out:
+        cs = summaries.get(e.callee)
+        if cs is not None and cs.param_release:
+            exempt.add(id(e.call))
+    frozen = frozenset(exempt)
+    return lambda stmt: _lifecycle_may_raise(stmt, frozen)
+
+
+def reply_obligated(src: SourceFile) -> Set[Tuple[str, str]]:
+    """(class-or-'', name) pairs from a module-level
+    ``ZOOLINT_REPLY_OBLIGATED = ("fn", "Class.method")`` tuple."""
+    out: Set[Tuple[str, str]] = set()
+    for node in src.tree.body:
+        if (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == REPLY_DECL
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            for e in node.value.elts:
+                if (isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)):
+                    cls, _, name = e.value.rpartition(".")
+                    out.add((cls, name))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# interprocedural summaries                                           #
+# ------------------------------------------------------------------ #
+class _Summary:
+    __slots__ = ("param_release", "param_transfer", "terminal",
+                 "resolution")
+
+    def __init__(self) -> None:
+        self.param_release: Set[str] = set()
+        self.param_transfer: Set[str] = set()
+        self.terminal = False
+        self.resolution = False
+
+
+_RELEASE_ARG_NAMES = {n.lstrip("_") for spec in DEFAULT_SPECS
+                      if spec.release_on == "arg"
+                      for n in spec.release}
+
+
+def _direct_summary(fn: FnNode) -> _Summary:
+    s = _Summary()
+    params = fn.all_params
+    for sub in own_nodes(fn):
+        if isinstance(sub, ast.Call):
+            name = _call_name(sub)
+            if name is None:
+                continue
+            if _is_push_call(sub):
+                s.terminal = True
+                s.resolution = True
+            if name.lstrip("_") in _SETTLE_NAMES:
+                s.resolution = True
+            if name.lstrip("_") in _RELEASE_ARG_NAMES:
+                for arg in list(sub.args) + [k.value
+                                             for k in sub.keywords]:
+                    s.param_release |= _names_in(arg) & params
+        elif isinstance(sub, ast.Return) and sub.value is not None:
+            s.param_transfer |= _names_in(sub.value) & params
+        elif isinstance(sub, ast.Assign):
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in sub.targets):
+                s.param_transfer |= _names_in(sub) & params
+    s.param_transfer -= s.param_release
+    return s
+
+
+def _build_summaries(graph: CallGraph) -> Dict[FnNode, _Summary]:
+    out = {fn: _direct_summary(fn) for fn in graph.nodes}
+    for _ in range(3):  # >= 2 interprocedural hops, bounded
+        changed = False
+        for fn in graph.nodes:
+            s = out[fn]
+            for edge in fn.edges_out:
+                cs = out.get(edge.callee)
+                if cs is None:
+                    continue
+                if cs.terminal and not s.terminal:
+                    s.terminal = changed = True
+                if cs.resolution and not s.resolution:
+                    s.resolution = changed = True
+                for pname, aexpr in edge.bindings:
+                    if (not isinstance(aexpr, ast.Name)
+                            or aexpr.id not in fn.all_params):
+                        continue
+                    if (pname in cs.param_release
+                            and aexpr.id not in s.param_release):
+                        s.param_release.add(aexpr.id)
+                        changed = True
+                    elif (pname in cs.param_transfer
+                          and aexpr.id not in s.param_transfer
+                          and aexpr.id not in s.param_release):
+                        s.param_transfer.add(aexpr.id)
+                        changed = True
+        if not changed:
+            break
+    return out
+
+
+# ------------------------------------------------------------------ #
+# per-node event extraction                                           #
+# ------------------------------------------------------------------ #
+class _Site:
+    """One acquire site. ``keys`` are the binding keys (var names, or
+    one dotted receiver); empty for an anonymous acquire (a bare
+    ``warming()`` statement) -- unreleasable by construction."""
+
+    __slots__ = ("uid", "spec", "keys", "line", "desc")
+
+    def __init__(self, uid: int, spec: ResourceSpec,
+                 keys: Tuple[str, ...], line: int, desc: str):
+        self.uid = uid
+        self.spec = spec
+        self.keys = keys
+        self.line = line
+        self.desc = desc
+
+
+class _FnCtx:
+    """Extraction output for one function: events per CFG node plus
+    the site registry the walker consults."""
+
+    def __init__(self, fn: FnNode, obligated: bool,
+                 specs: Tuple[ResourceSpec, ...],
+                 summaries: Dict[FnNode, _Summary]):
+        self.fn = fn
+        self.obligated = obligated
+        self.specs = specs
+        self.summaries = summaries
+        self.params = set(fn.all_params)
+        self.edges: Dict[int, List] = {}
+        for edge in fn.edges_out:
+            self.edges.setdefault(id(edge.call), []).append(edge)
+        self.sites: Dict[int, _Site] = {}
+        self._site_by_call: Dict[int, _Site] = {}
+        self.events: Dict[int, Tuple] = {}
+        self._stmt_cache: Dict[Tuple[int, str], Tuple] = {}
+        self.acquire_keys: Set[str] = set()
+        self.released_keys: Set[str] = set()
+        self.credit: Set[int] = set()
+
+    def site_for(self, call: ast.Call, spec: ResourceSpec,
+                 keys: Tuple[str, ...], desc: str) -> _Site:
+        # keyed on the call AST so duplicated finally copies share one
+        # site (one finding per source acquire, not per CFG copy)
+        site = self._site_by_call.get(id(call))
+        if site is None:
+            site = _Site(len(self.sites), spec, keys, call.lineno,
+                         desc)
+            self.sites[site.uid] = site
+            self._site_by_call[id(call)] = site
+            self.acquire_keys |= set(keys)
+        return site
+
+
+# events: ("acquire", site) | ("release", key, desc, direct) |
+# ("transfer", names) | ("kill", names) | ("push", line) |
+# ("resolve",)
+def _node_events(node: Node, ctx: _FnCtx) -> Tuple:
+    stmt = node.stmt
+    if stmt is None:
+        return ()
+    kind = node.kind
+    key = (id(stmt), kind)
+    cached = ctx._stmt_cache.get(key)
+    if cached is not None:
+        return cached
+    evs: Tuple
+    if kind in ("stmt", "raise"):
+        evs = _simple_stmt_events(stmt, ctx)
+    elif kind == "loop" and isinstance(stmt, (ast.For, ast.AsyncFor)):
+        names = _target_names(stmt.target) or sorted(
+            _names_in(stmt.target))
+        evs = (("kill", tuple(names)),) if names else ()
+    elif kind == "with" and isinstance(stmt, (ast.With, ast.AsyncWith)):
+        names = [n for it in stmt.items
+                 if it.optional_vars is not None
+                 for n in _target_names(it.optional_vars)]
+        evs = (("kill", tuple(names)),) if names else ()
+    elif kind == "except" and isinstance(stmt, ast.ExceptHandler):
+        evs = (("kill", (stmt.name,)),) if stmt.name else ()
+    else:  # branch tests, finally/with-exit anchors: no effects here
+        evs = ()
+    ctx._stmt_cache[key] = evs
+    for ev in evs:
+        if ev[0] == "release":
+            ctx.released_keys.add(ev[1])
+    return evs
+
+
+def _collect_calls(stmt: ast.stmt) -> List[ast.Call]:
+    """Calls executing in this statement (nested scopes pruned), with
+    a stmt-local parent map for context classification."""
+    calls: List[ast.Call] = []
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        cur = stack.pop()
+        for ch in ast.iter_child_nodes(cur):
+            if isinstance(ch, _NESTED_SCOPES):
+                continue
+            _PARENTS[id(ch)] = cur
+            stack.append(ch)
+            if isinstance(ch, ast.Call):
+                calls.append(ch)
+    calls.sort(key=lambda c: (c.lineno, c.col_offset))
+    return calls
+
+
+_PARENTS: Dict[int, ast.AST] = {}
+
+
+def _result_bind_keys(call: ast.Call, stmt: ast.stmt
+                      ) -> Optional[Tuple[str, ...]]:
+    """Where a result-bound acquire's token lives. Returns a key
+    tuple; () means anonymous (bare Expr -- a guaranteed leak); None
+    means born transferred or scoped (return / call argument / with
+    item / anything unresolvable) -- untracked."""
+    cur: ast.AST = call
+    while True:
+        parent = _PARENTS.get(id(cur))
+        if parent is None or parent is stmt:
+            break
+        if isinstance(parent, (ast.Call, ast.Return, ast.withitem)):
+            return None
+        if not isinstance(parent, ast.Await):
+            return None  # tuple literal, boolop, comparison, ...
+        cur = parent
+    if isinstance(stmt, ast.Assign) and stmt.value in (call, cur):
+        keys: List[str] = []
+        for t in stmt.targets:
+            names = _target_names(t)
+            if not names:
+                return None  # attribute/subscript target: stored away
+            keys.extend(names)
+        return tuple(keys)
+    if (isinstance(stmt, ast.AnnAssign) and stmt.value in (call, cur)
+            and isinstance(stmt.target, ast.Name)):
+        return (stmt.target.id,)
+    if isinstance(stmt, ast.Expr) and stmt.value in (call, cur):
+        return ()
+    if isinstance(stmt, ast.Return):
+        return None
+    return None
+
+
+def _classify_acquire(call: ast.Call, stmt: ast.stmt, ctx: _FnCtx
+                      ) -> Optional[Tuple]:
+    """An ("acquire", site) event when some spec matches this call in
+    a trackable position, else None."""
+    name = _call_name(call)
+    if name is None:
+        return None
+    recv = (_dotted(call.func.value)
+            if isinstance(call.func, ast.Attribute) else None)
+    for spec in ctx.specs:
+        if name not in spec.acquire:
+            continue
+        if spec.ctor_roots is not None and isinstance(
+                call.func, ast.Attribute):
+            root = _attr_root_name(call.func.value)
+            if root not in spec.ctor_roots:
+                continue
+        if spec.receiver_hints:
+            parts = set(recv.split(".")) if recv else set()
+            if not parts & set(spec.receiver_hints):
+                continue
+        if spec.daemon_exempt and _kw_is_true(call, "daemon"):
+            return None
+        desc = _dotted(call.func) or name
+        if spec.bind == "arg":
+            if not (call.args and isinstance(call.args[0], ast.Name)):
+                return None
+            site = ctx.site_for(call, spec, (call.args[0].id,), desc)
+            return ("acquire", site)
+        if spec.bind == "receiver":
+            if recv is None or not (isinstance(stmt, ast.Expr)
+                                    and stmt.value is call):
+                return None  # conditional/derived acquire: untracked
+            site = ctx.site_for(call, spec, (recv,), desc)
+            return ("acquire", site)
+        keys = _result_bind_keys(call, stmt)
+        if keys is None:
+            return None
+        site = ctx.site_for(call, spec, keys, desc)
+        return ("acquire", site)
+    return None
+
+
+def _simple_stmt_events(stmt: ast.stmt, ctx: _FnCtx) -> Tuple:
+    if isinstance(stmt, _NESTED_SCOPES):
+        return ()
+    releases: List[Tuple] = []
+    marks: List[Tuple] = []
+    transfers: List[Tuple] = []
+    kills: List[Tuple] = []
+    acquires: List[Tuple] = []
+    for call in _collect_calls(stmt):
+        name = _call_name(call)
+        if name is None:
+            continue
+        desc = _dotted(call.func) or name
+        if ctx.obligated:
+            if _is_push_call(call):
+                marks.append(("push", call.lineno))
+            elif _is_settle_call(call) or _is_handoff_call(call):
+                marks.append(("resolve",))
+        acq = _classify_acquire(call, stmt, ctx)
+        if acq is not None:
+            acquires.append(acq)
+            continue
+        lname = name.lstrip("_")
+        released_here = False
+        for spec in ctx.specs:
+            if lname not in {n.lstrip("_") for n in spec.release}:
+                continue
+            if spec.release_on == "receiver":
+                recv = (_dotted(call.func.value)
+                        if isinstance(call.func, ast.Attribute)
+                        else None)
+                if recv is not None:
+                    releases.append(("release", recv, desc, True))
+                    released_here = True
+            else:
+                for arg in list(call.args) + [k.value
+                                              for k in call.keywords]:
+                    for nm in sorted(_names_in(arg)):
+                        releases.append(("release", nm, desc, True))
+                        released_here = True
+        edges = ctx.edges.get(id(call))
+        if edges:
+            resolution = False
+            for edge in edges:
+                cs = ctx.summaries.get(edge.callee)
+                if cs is None:
+                    continue
+                resolution |= cs.resolution or cs.terminal
+                for pname, aexpr in edge.bindings:
+                    if not isinstance(aexpr, ast.Name):
+                        continue
+                    if pname in cs.param_release:
+                        releases.append(
+                            ("release", aexpr.id, desc, False))
+                    elif pname in cs.param_transfer:
+                        transfers.append(("transfer", (aexpr.id,)))
+            if resolution and ctx.obligated:
+                marks.append(("resolve",))
+        elif not released_here:
+            # unresolved call: conservatively assume it takes
+            # ownership of every plain-name argument
+            root = (call.func.id if isinstance(call.func, ast.Name)
+                    else _attr_root_name(call.func.value))
+            if not (isinstance(call.func, ast.Name)
+                    and call.func.id in _PURE_BUILTINS
+                    ) and root not in _LOG_ROOTS:
+                names: Set[str] = set()
+                for arg in list(call.args) + [k.value
+                                              for k in call.keywords]:
+                    names |= _names_in(arg)
+                if names:
+                    transfers.append(("transfer",
+                                      tuple(sorted(names))))
+    # statement-level binds/stores
+    if isinstance(stmt, ast.Assign):
+        plain: List[str] = []
+        stored = False
+        for t in stmt.targets:
+            names = _target_names(t)
+            if names:
+                plain.extend(names)
+            else:
+                stored = True
+        if stored:
+            transfers.append(("transfer",
+                              tuple(sorted(_names_in(stmt)))))
+            if ctx.obligated and any(
+                    isinstance(t, ast.Subscript)
+                    and _attr_root_name(t.value) == "self"
+                    for t in stmt.targets):
+                marks.append(("resolve",))
+        if plain:
+            kills.append(("kill", tuple(plain)))
+    elif isinstance(stmt, ast.AnnAssign):
+        if isinstance(stmt.target, ast.Name) and stmt.value is not None:
+            kills.append(("kill", (stmt.target.id,)))
+    elif isinstance(stmt, ast.AugAssign):
+        if isinstance(stmt.target, ast.Name):
+            kills.append(("kill", (stmt.target.id,)))
+    elif isinstance(stmt, ast.Return):
+        if stmt.value is not None:
+            names = _names_in(stmt.value)
+            if names:
+                transfers.append(("transfer", tuple(sorted(names))))
+    elif isinstance(stmt, ast.Delete):
+        names = [t.id for t in stmt.targets
+                 if isinstance(t, ast.Name)]
+        if names:
+            kills.append(("kill", tuple(names)))
+    return tuple(releases + marks + transfers + kills + acquires)
+
+
+# ------------------------------------------------------------------ #
+# the product walk: (CFG node, abstract state)                        #
+# ------------------------------------------------------------------ #
+# binds: ((key, uid-or-None), ...)  None = known-rebound tombstone
+# status: ((uid, "H"|"R"|"T"), ...)  pushes: sorted tuple of distinct
+# push call-site lines hit on this path (capped at 2 -- beyond that
+# the verdict is already settled); pending: 0 none / 1 implicit
+# exception in flight / 2 explicit raise in flight
+_St = collections.namedtuple(
+    "_St", ("binds", "status", "pushes", "resolved", "pending"))
+
+_STATE_CAP = 80_000
+
+
+def _frz(d: Dict) -> Tuple:
+    return tuple(sorted(d.items()))
+
+
+def _label(site: _Site) -> str:
+    return ", ".join(site.keys)
+
+
+def _strict_key(ctx: _FnCtx, key: str) -> bool:
+    return any(key in s.keys and s.spec.strict_release
+               for s in ctx.sites.values())
+
+
+def _leak_finding(ctx: _FnCtx, site: _Site, phrase: str, where: str,
+                  rel: str) -> Finding:
+    spec = site.spec
+    if not site.keys:
+        msg = (f"{where}: the {site.desc}() result is discarded -- "
+               f"the {spec.describe} can never close; use "
+               f"`with {site.desc}():` (or bind and release it)")
+    else:
+        rel_desc = "/".join(spec.release) or "a with-scope"
+        msg = (f"{where}: {spec.describe} '{_label(site)}' acquired "
+               f"via {site.desc}() can leave the function on "
+               f"{phrase} without {rel_desc} or an ownership "
+               "transfer; release it on every path (try/except -> "
+               "release + re-raise, or a finally block)")
+    return Finding("leak-on-path", "error", rel, site.line, msg)
+
+
+def _apply(ctx: _FnCtx, node: Node, st: "_St", where: str, rel: str,
+           out: Dict) -> "_St":
+    evs = ctx.events.get(node.idx)
+    if not evs:
+        return st
+    binds = dict(st.binds)
+    status = dict(st.status)
+    pushes, resolved = st.pushes, st.resolved
+    for ev in evs:
+        k = ev[0]
+        if k == "release":
+            key = ev[1]
+            if key in binds:
+                uid = binds[key]
+                if uid is None:
+                    continue
+                site = ctx.sites[uid]
+                c = status.get(uid)
+                if c == "H":
+                    status[uid] = "R"
+                elif c == "R" and site.spec.strict_release:
+                    out.setdefault(("double", uid, node.line), Finding(
+                        "double-release", "error", rel, node.line,
+                        f"{where}: {site.spec.describe} "
+                        f"'{_label(site)}' from {site.desc}() is "
+                        "released more than once on a single path -- "
+                        "a second release can free a resource "
+                        "re-acquired by a concurrent request; make "
+                        "one site own the release"))
+            elif ev[3]:  # direct release of a never-bound key
+                if (key in ctx.acquire_keys
+                        and key not in ctx.params
+                        and _strict_key(ctx, key)):
+                    out.setdefault(("unacq", key, node.line), Finding(
+                        "release-unacquired", "error", rel, node.line,
+                        f"{where}: '{key}' is released on a path "
+                        "where no acquire bound it (the acquire is "
+                        "conditional or on another branch); guard "
+                        "the release with the same condition"))
+        elif k == "transfer":
+            for nm in ev[1]:
+                uid = binds.get(nm)
+                if uid is not None and status.get(uid) == "H":
+                    status[uid] = "T"
+        elif k == "kill":
+            for nm in ev[1]:
+                if nm in binds:
+                    binds[nm] = None
+        elif k == "acquire":
+            site = ev[1]
+            for key in site.keys:
+                binds[key] = site.uid
+            status[site.uid] = "H"
+        elif k == "push":
+            resolved = True
+            # per-SITE, not per-execution: the same site re-fired via
+            # a loop back edge is the per-batch reply loop, not a
+            # duplicate reply for one request
+            if ev[1] not in pushes and len(pushes) < 2:
+                pushes = tuple(sorted(pushes + (ev[1],)))
+                if len(pushes) == 2:
+                    out.setdefault(("dup", node.line), Finding(
+                        "reply-duplicated-on-path", "error", rel,
+                        node.line,
+                        f"{where}: two distinct terminal reply "
+                        "pushes can both fire for one request on a "
+                        "single path -- consumers would see a "
+                        "duplicate; make exactly one reachable "
+                        "(exactly-once contract)"))
+        else:  # resolve
+            resolved = True
+    return _St(_frz(binds), _frz(status), pushes, resolved,
+               st.pending)
+
+
+def _finalize(ctx: _FnCtx, st: "_St", exceptional: bool,
+              prev_line: int, where: str, rel: str,
+              out: Dict) -> None:
+    for uid, c in st.status:
+        if c != "H":
+            continue
+        site = ctx.sites[uid]
+        spec = site.spec
+        if exceptional:
+            if spec.exc_safe:
+                continue
+            implicit = st.pending != 2
+            has_release = any(k in ctx.released_keys
+                              for k in site.keys)
+            if implicit and has_release:
+                out.setdefault(("cleanup", uid), Finding(
+                    "cleanup-not-in-finally", "warning", rel,
+                    site.line,
+                    f"{where}: the release of {spec.describe} "
+                    f"'{_label(site)}' (acquired via {site.desc}()) "
+                    "runs only on the fall-through path -- an "
+                    "exception between the acquire and the release "
+                    "skips it; move the release into a finally "
+                    "block, or a try/except that releases and "
+                    "re-raises"))
+            else:
+                key = ("leak", uid, "anon" if not site.keys
+                       else "exc")
+                out.setdefault(key, _leak_finding(
+                    ctx, site, "an exception path", where, rel))
+        else:
+            key = ("leak", uid, "anon" if not site.keys else "norm")
+            out.setdefault(key, _leak_finding(
+                ctx, site, "an early-return or fall-through path",
+                where, rel))
+    if ctx.obligated and not exceptional and not st.resolved:
+        out.setdefault(("missing", prev_line), Finding(
+            "reply-missing-on-path", "error", rel, prev_line,
+            f"{where}: a pulled request can reach a normal return "
+            "with no reply, error-reply, requeue, or ownership "
+            "hand-off on that path -- the exactly-once contract "
+            "requires each path to resolve the request exactly once "
+            "(suppress with a rationale only for intentional drops)"))
+
+
+def _walk(ctx: _FnCtx, cfg: CFG, rel: str, out: Dict) -> None:
+    fn = ctx.fn
+    where = (f"{fn.cls_name}.{fn.name}" if fn.cls_name else fn.name)
+    init = _St((), (), (), False, 0)
+    seen: Set[Tuple] = set()
+    stack = [(cfg.entry, init, getattr(fn.node, "lineno", 0))]
+    while stack:
+        node, st, prev_line = stack.pop()
+        mkey = (node.idx, st)
+        if mkey in seen:
+            continue
+        seen.add(mkey)
+        if len(seen) > _STATE_CAP:
+            return  # bail out; findings discovered so far stand
+        kind = node.kind
+        if kind == "exit":
+            _finalize(ctx, st, False, prev_line, where, rel, out)
+            continue
+        if kind == "raise-exit":
+            _finalize(ctx, st, True, prev_line, where, rel, out)
+            continue
+        if kind == "except" and st.pending:
+            st = st._replace(pending=0)  # the handler caught it
+        post = _apply(ctx, node, st, where, rel, out)
+        if (kind == "loop" and node.idx in ctx.credit
+                and ctx.obligated and not post.resolved):
+            # zero iterations = zero pulled requests: vacuously
+            # settled, so entering a resolving loop grants resolution
+            post = post._replace(resolved=True)
+        line = node.line or prev_line
+        for succ, label in node.succ:
+            if label == "mayraise":
+                # effects have NOT happened on an implicit edge
+                nxt = st if st.pending else st._replace(pending=1)
+            elif label == "raise":
+                nxt = st._replace(pending=2)
+            else:  # next/true/false/back/return/break/case/exc
+                nxt = post
+            stack.append((succ, nxt, line))
+
+
+# ------------------------------------------------------------------ #
+# checker                                                             #
+# ------------------------------------------------------------------ #
+def _walk_pruned(node: ast.AST) -> Iterable[ast.AST]:
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for ch in ast.iter_child_nodes(cur):
+            if not isinstance(ch, _NESTED_SCOPES):
+                stack.append(ch)
+
+
+@register
+class LifecycleChecker(Checker):
+    """Engine #4: path-sensitive pairing over per-function CFGs."""
+
+    name = "lifecycle"
+    rules = {
+        "leak-on-path": "an acquired resource (KV slot, ledger "
+                        "entry, lock, thread, warming scope) escapes "
+                        "on some path without release or ownership "
+                        "transfer",
+        "double-release": "a resource is released twice along a "
+                          "single path",
+        "release-unacquired": "a release runs on a path where its "
+                              "acquire never did",
+        "cleanup-not-in-finally": "happy-path-only cleanup: an "
+                                  "exception edge skips the release",
+        "reply-missing-on-path": "a ZOOLINT_REPLY_OBLIGATED stage "
+                                 "method can return without "
+                                 "resolving the pulled request",
+        "reply-duplicated-on-path": "a stage method can push two "
+                                    "terminal replies on one path",
+    }
+
+    def __init__(self, specs: Optional[Iterable[ResourceSpec]] = None):
+        self.specs: Tuple[ResourceSpec, ...] = (
+            tuple(specs) if specs is not None else DEFAULT_SPECS)
+        self._acq_names = {n for s in self.specs for n in s.acquire}
+
+    # ------------------------------------------------------ driver --
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        graph = build_call_graph(project)
+        summaries = _build_summaries(graph)
+        decls: Dict[str, Set[Tuple[str, str]]] = {}
+        findings: List[Finding] = []
+        for fn in graph.nodes:
+            rel = fn.src.rel
+            if rel not in decls:
+                decls[rel] = reply_obligated(fn.src)
+            obligated = (fn.cls_name or "", fn.name) in decls[rel]
+            if not obligated and not self._prescan(fn):
+                continue
+            cfg = build_cfg(fn.node,
+                            may_raise=_may_raise_for(fn, summaries))
+            if cfg is None:
+                continue  # overflow: no knowledge, never a finding
+            ctx = _FnCtx(fn, obligated, self.specs, summaries)
+            for node in cfg.nodes:
+                ctx.events[node.idx] = _node_events(node, ctx)
+            self._loop_credit(cfg, ctx)
+            out: Dict[Tuple, Finding] = {}
+            _walk(ctx, cfg, rel, out)
+            for uid in ctx.sites:
+                # a site leaking on a normal path also leaks on its
+                # exception paths; one finding carries the fix
+                if ("leak", uid, "norm") in out:
+                    out.pop(("leak", uid, "exc"), None)
+            findings.extend(out.values())
+        return findings
+
+    def _prescan(self, fn: FnNode) -> bool:
+        """Only functions that acquire anything get a CFG built."""
+        for sub in own_nodes(fn):
+            if (isinstance(sub, ast.Call)
+                    and _call_name(sub) in self._acq_names):
+                return True
+        return False
+
+    @staticmethod
+    def _loop_credit(cfg: CFG, ctx: _FnCtx) -> None:
+        if not ctx.obligated:
+            return
+        for node in cfg.nodes:
+            if node.kind != "loop" or node.idx in ctx.credit:
+                continue
+            for s in getattr(node.stmt, "body", []):
+                for sub in _walk_pruned(s):
+                    if isinstance(sub, ast.Call) and (
+                            _is_push_call(sub) or _is_settle_call(sub)
+                            or _is_handoff_call(sub)):
+                        ctx.credit.add(node.idx)
+                        break
+                    if (isinstance(sub, ast.Assign) and any(
+                            isinstance(t, ast.Subscript)
+                            and _attr_root_name(t.value) == "self"
+                            for t in sub.targets)):
+                        ctx.credit.add(node.idx)
+                        break
+                if node.idx in ctx.credit:
+                    break

@@ -128,7 +128,14 @@ class PagedKVCache:
         ``prompt_len + max_new_tokens`` tokens; reserves (but does not
         yet assign) the worst-case pages. Raises :class:`CacheOverflow`
         when no slot or not enough free pages -- the one refusal point
-        of a generation request's lifetime."""
+        of a generation request's lifetime.
+
+        A successful ``admit`` opens an obligation: every code path
+        that can run afterwards must reach :meth:`release` or hand the
+        slot to an owner that will (e.g. the worker's stream table).
+        zoolint's lifecycle engine proves this per CFG path at review
+        time (``leak-on-path``, docs/zoolint.md) -- the static form of
+        the PR-10 admit-window capacity leak."""
         total = int(prompt_len) + int(max_new_tokens)
         with self._lock:
             if total > self.max_len:

@@ -35,6 +35,13 @@ Everything here runs in the controller process; replicas are plain
 ``python -m analytics_zoo_tpu.serving.launcher`` deployments (drain on
 SIGTERM, supervised worker, own HTTP frontend) -- the fleet is an
 arrangement of already-hardened pieces, not a second serving engine.
+
+The exactly-once story this module closes at runtime (claim, ack on
+reply, reclaim on death) has a static twin: zoolint's lifecycle
+engine proves the worker-side half -- that every claimed request
+reaches exactly one reply/requeue on every code path, and that
+replica/thread/lock lifecycles pair acquire with release
+(docs/zoolint.md, "leakcheck").
 """
 
 from __future__ import annotations

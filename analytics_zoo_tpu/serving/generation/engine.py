@@ -212,7 +212,10 @@ class DecodeEngine:
         prompt into the pool, return ``(slot, first_token)``. Raises
         :class:`CacheOverflow` (the caller maps it to the structured
         ``generation_overflow`` refusal) and ValueError on an empty or
-        over-long prompt."""
+        over-long prompt. On success the CALLER owns the slot and owes
+        :meth:`release` on every path (zoolint ``leak-on-path``
+        enforces the pairing statically); on any failure past the
+        claim, the slot is given back here before re-raising."""
         import jax.numpy as jnp
 
         prompt = np.asarray(prompt, np.int32).reshape(-1)

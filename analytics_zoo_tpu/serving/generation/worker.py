@@ -58,6 +58,15 @@ from analytics_zoo_tpu.serving.queues import _decode_generation, _encode
 
 logger = get_logger(__name__)
 
+# exactly-once-reply obligation (zoolint lifecycle engine): every
+# path through these stage methods must reach a reply, error-reply,
+# requeue, or ownership hand-off -- the static twin of the ledger
+ZOOLINT_REPLY_OBLIGATED = (
+    "GenerationWorker._admit_blob",
+    "GenerationWorker._finish_stream",
+    "GenerationWorker._abort_stream",
+)
+
 _REG = get_registry()
 _M_REQS = _REG.counter(
     "zoo_generation_requests_total",
@@ -220,7 +229,9 @@ class GenerationWorker:
         except Exception as e:
             logger.exception(
                 "generation: undecodable request dropped: %s", e)
-            return 0
+            # intentional drop: an undecodable blob has no uri/reply
+            # channel to answer on -- logging IS the accounting here
+            return 0  # zoolint: disable=reply-missing-on-path
         if self.ledger is not None:
             self.ledger.record(uri, blob)
         if deadline is not None and time.time() > deadline:
@@ -275,12 +286,21 @@ class GenerationWorker:
                              uri, e)
             self._push_error(uri, reply, str(e))
             return 1
-        if trace:
-            get_tracer().add_span("gen_prefill", trace, t0,
-                                  time.perf_counter())
-        get_inflight().add((uri,))
-        stream = _GenStream(uri, reply, trace, deadline, eos, max_toks)
-        self._streams[slot] = stream
+        try:
+            if trace:
+                get_tracer().add_span("gen_prefill", trace, t0,
+                                      time.perf_counter())
+            get_inflight().add((uri,))
+            stream = _GenStream(uri, reply, trace, deadline, eos,
+                                max_toks)
+            self._streams[slot] = stream
+        except BaseException:
+            # nothing owns the slot until the stream table does: a
+            # raise in this window (tracer, crash manifest, stream
+            # allocation) would leak the KV reservation until restart
+            # -- the admit-path capacity leak leak-on-path guards
+            self.engine.release(slot)
+            raise
         emit_event("generation_admit", "generation", uri=uri,
                    slot=slot, prompt_len=int(np.asarray(prompt).size),
                    bucket=next(b for b in self.engine.ladder
@@ -365,7 +385,9 @@ class GenerationWorker:
         slot frees exactly like a completion."""
         stream = self._streams.pop(slot, None)
         if stream is None:
-            return 0
+            # no stream owns the slot: nothing was admitted, so there
+            # is no request to answer (abort raced a finished stream)
+            return 0  # zoolint: disable=reply-missing-on-path
         self._push_error(stream.uri, stream.reply, message)
         self.engine.release(slot)
         self.served += 1

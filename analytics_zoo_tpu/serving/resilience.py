@@ -81,7 +81,14 @@ class RequestLedger:
     the exactly-once policy: the first drain returns an entry for
     re-queueing and remembers it; a second drain (the re-run died too)
     returns it as *dead* -- the caller answers it with a structured
-    error and it leaves the ledger for good."""
+    error and it leaves the ledger for good.
+
+    The record/settle pairing is the runtime half of the exactly-once
+    contract; zoolint's lifecycle engine is the static half -- worker
+    stage methods declared in ``ZOOLINT_REPLY_OBLIGATED`` are proven
+    to reach exactly one of {reply, error-reply, requeue, handoff} on
+    every CFG path (``reply-missing-on-path`` /
+    ``reply-duplicated-on-path``, docs/zoolint.md)."""
 
     def __init__(self, max_entries: int = 4096):
         self._lock = threading.Lock()

@@ -54,6 +54,14 @@ from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
 
+# exactly-once-reply obligation (zoolint lifecycle engine): every
+# path through these stage methods must reach a reply, error-reply,
+# requeue, or ownership hand-off -- the static twin of the ledger
+ZOOLINT_REPLY_OBLIGATED = (
+    "ServingWorker._predict_group",
+    "ServingWorker._finalize_record",
+)
+
 # unified-registry wiring (obs, ISSUE-2): stage latencies as one
 # labelled histogram family (every worker Timer mirrors into it),
 # request/error counters, and the pipeline's operational gauges --
@@ -644,7 +652,12 @@ class ServingWorker:
         except Exception as e:
             logger.exception("serving finalize failed (results for %d "
                              "requests lost): %s", len(uris), e)
-            return len(uris)
+            # intentional: if the finally block itself raised before
+            # settle/ack ran, the ledger entry and broker claim stay
+            # pending -- the supervisor/replica requeue redelivers the
+            # request, so the contract degrades to at-least-once
+            # rather than silently losing the reply
+            return len(uris)  # zoolint: disable=reply-missing-on-path
 
     def _finalize_inner(self, uris, replies, preds, n,
                         deadlines=None) -> int:

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# Fast lint gate: zoolint over the package plus the two tier-1 test
-# modules that enforce its contracts (the zoolint gate itself and the
-# metric/event vocabulary lint). Runs in seconds -- wire it before the
+# Fast lint gate: zoolint over the package plus the tier-1 test
+# modules that enforce its contracts (the zoolint gate itself, the
+# CFG/lifecycle engine suite, and the metric/event vocabulary lint).
+# Runs in seconds -- wire it before the
 # full suite locally (pre-push) and first in CI so lint regressions
 # fail fast.
 #
@@ -32,9 +33,9 @@ done
 echo "== zoolint =="
 python scripts/zoolint.py "${ARGS[@]+"${ARGS[@]}"}"
 
-echo "== gate tests (test_zoolint, test_metric_names) =="
-python -m pytest tests/test_zoolint.py tests/test_metric_names.py \
-    -q -p no:cacheprovider
+echo "== gate tests (test_zoolint, test_zoolint_lifecycle, test_metric_names) =="
+python -m pytest tests/test_zoolint.py tests/test_zoolint_lifecycle.py \
+    tests/test_metric_names.py -q -p no:cacheprovider
 
 if [ "$SOAK" = 1 ]; then
     echo "== fleet chaos soak (smoke) =="

@@ -4,6 +4,8 @@
 Usage:
     python scripts/zoolint.py [paths ...]          # default: analytics_zoo_tpu
     python scripts/zoolint.py --json analytics_zoo_tpu
+    python scripts/zoolint.py --format sarif > zoolint.sarif
+    python scripts/zoolint.py --profile            # per-family timing table
     python scripts/zoolint.py --baseline zoolint_baseline.json pkg/
     python scripts/zoolint.py --update-baseline    # grandfather current findings
     python scripts/zoolint.py --list-rules
@@ -27,6 +29,70 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 DEFAULT_BASELINE = os.path.join(REPO, "zoolint_baseline.json")
+
+# zoolint severity -> SARIF result level
+_SARIF_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def _sarif_log(findings, baseline, rule_catalog):
+    """Minimal SARIF 2.1.0 log: one run, the full rule catalog in the
+    driver (so viewers resolve ruleIndex even for clean runs), one
+    result per finding. ``baselineState`` carries the baseline verdict
+    so GitHub code scanning only annotates NEW findings."""
+    rule_ids = sorted(rule_catalog)
+    rule_index = {r: i for i, r in enumerate(rule_ids)}
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": rule_index.get(f.rule, -1),
+            "level": _SARIF_LEVELS.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "baselineState": ("unchanged" if f.key() in baseline
+                              else "new"),
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "%SRCROOT%"},
+                    # SARIF regions are 1-based; whole-file findings
+                    # (line 0) anchor to the first line
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "zoolint",
+                "informationUri":
+                    "https://github.com/analytics-zoo-tpu",
+                "rules": [{"id": r,
+                           "shortDescription":
+                               {"text": rule_catalog[r]}}
+                          for r in rule_ids],
+            }},
+            "columnKind": "utf16CodeUnits",
+            "originalUriBaseIds": {"%SRCROOT%": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
+def _print_profile(timings, n_findings):
+    """Per-family wall-clock table on stderr (stdout stays parseable
+    for --format json/sarif consumers)."""
+    total = sum(timings.values())
+    print("zoolint profile (wall seconds per checker family):",
+          file=sys.stderr)
+    for name, secs in sorted(timings.items(),
+                             key=lambda kv: -kv[1]):
+        pct = 100.0 * secs / total if total else 0.0
+        print(f"  {name:14s} {secs:7.3f}s  {pct:5.1f}%",
+              file=sys.stderr)
+    print(f"  {'total':14s} {total:7.3f}s  ({n_findings} finding(s))",
+          file=sys.stderr)
 
 
 def _changed_files(ref: str):
@@ -61,7 +127,16 @@ def main(argv=None) -> int:
                     help="files/dirs to lint (default: the "
                          "analytics_zoo_tpu package)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable output")
+                    help="machine-readable output (alias for "
+                         "--format json)")
+    ap.add_argument("--format", default=None, dest="fmt",
+                    choices=("text", "json", "sarif"),
+                    help="output format; sarif emits a SARIF 2.1.0 "
+                         "log for GitHub code-scanning annotations "
+                         "(baselined findings are marked unchanged)")
+    ap.add_argument("--profile", action="store_true",
+                    help="print per-checker-family wall-clock timings "
+                         "to stderr after the run")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help="baseline json of grandfathered findings "
                          "(default: zoolint_baseline.json at the repo "
@@ -88,12 +163,18 @@ def main(argv=None) -> int:
                          "still read from the full tree; findings "
                          "outside the changed files are dropped")
     args = ap.parse_args(argv)
+    fmt = args.fmt or ("json" if args.as_json else "text")
 
     def _nothing_changed(detail: str) -> int:
         # the pre-push fast path: nothing to lint (none of the heavy
-        # imports below ever run). --json consumers still get the
-        # documented object shape, not a prose line.
-        if args.as_json:
+        # imports below ever run). --json/--format consumers still
+        # get the documented object shape, not a prose line; an empty
+        # SARIF log carries no rule catalog (uploaders only read
+        # results from it).
+        if fmt == "sarif":
+            print(json.dumps(_sarif_log([], {}, {}), indent=2,
+                             sort_keys=True))
+        elif fmt == "json":
             print(json.dumps({
                 "findings": [], "new": [], "stale_baseline": [],
                 "counts": {"total": 0, "new": 0, "baselined": 0,
@@ -167,7 +248,11 @@ def main(argv=None) -> int:
             print(f"zoolint: unknown rules: {', '.join(sorted(unknown))}",
                   file=sys.stderr)
             return 2
-    findings = run_zoolint(paths, rules=rules, report_only=report_only)
+    timings = {} if args.profile else None
+    findings = run_zoolint(paths, rules=rules, report_only=report_only,
+                           timings=timings)
+    if timings is not None:
+        _print_profile(timings, len(findings))
 
     baseline_path = args.baseline
     if baseline_path is None and not args.no_baseline:
@@ -190,7 +275,12 @@ def main(argv=None) -> int:
     stale = (stale_entries(findings, baseline)
              if baseline and report_only is None else [])
 
-    if args.as_json:
+    if fmt == "sarif":
+        print(json.dumps(_sarif_log(findings, baseline, all_rules()),
+                         indent=2, sort_keys=True))
+        return 1 if fresh else 0
+
+    if fmt == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in findings],
             "new": [f.to_dict() for f in fresh],

@@ -317,6 +317,45 @@ class TestGenerationWorker:
         assert stats["slots_free"] == 4
         assert stats["pages_assigned"] == 0
 
+    def test_admit_window_failure_releases_slot(self, tiny_lm,
+                                                monkeypatch):
+        """ISSUE-12 dogfood fix (leak-on-path): a raise in the window
+        between ``engine.admit`` and the stream-table store (tracer,
+        crash-manifest registry, stream allocation) must give the slot
+        and its page reservation back -- before the fix the KV
+        reservation leaked until restart, a capacity DoS the new
+        lifecycle engine now flags statically."""
+        import analytics_zoo_tpu.serving.generation.worker as gw
+
+        w, in_q, out_q = self._worker(tiny_lm)
+        in_q.enqueue_generation("leaky", np.array([1, 2, 3], np.int32),
+                                max_tokens=8)
+        blobs = w.batcher.poll(1, wait_timeout=1.0, idle=True)
+        assert len(blobs) == 1
+
+        def boom():
+            raise RuntimeError("injected inflight-registry failure")
+
+        monkeypatch.setattr(gw, "get_inflight", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            w._admit_blob(blobs[0])
+        monkeypatch.undo()
+        # slot, pages, and reservation all recovered; no ghost stream
+        assert w._streams == {}
+        stats = w.engine.cache.stats()
+        assert stats["slots_free"] == 4
+        assert stats["pages_assigned"] == 0
+        assert stats["pages_reserved_unassigned"] == 0
+        # and the worker still serves the next request end to end
+        in_q.enqueue_generation("ok", np.array([1, 2, 3], np.int32),
+                                max_tokens=4)
+        w.start()
+        try:
+            got = _drain_stream(out_q, ["ok"])
+        finally:
+            w.stop()
+        assert got["ok"]["n_tokens"] == 4
+
     def test_eos_stops_stream(self, tiny_lm):
         w, in_q, out_q = self._worker(tiny_lm)
         prompt = np.array([3, 7, 1, 9, 2], np.int32)

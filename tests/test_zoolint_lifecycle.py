@@ -1,0 +1,802 @@
+"""Engine #4 (ISSUE-12): CFG construction + path-sensitive lifecycle.
+
+Four layers, all pure AST (no device work):
+
+1. **CFG construction unit tests** -- shape assertions over
+   ``analysis.cfg``: path counts for branches/loops, finally-runs-
+   after-return ordering, with-unwind on the exception path, break/
+   continue routing, the overflow cap.
+2. **TP/FP fixture pairs per lifecycle rule** -- every rule gets a
+   minimal known-true-positive and the nearest known-false-positive
+   (the idiom one refactor away), so precision regressions break CI.
+3. **TestPriorEnginesMissLifecycle** -- the ISSUE-12 acceptance
+   fixture: real hazard patterns from this repo's history (the PR-10
+   admit slot leak verbatim among them) that produce ZERO findings
+   from all three prior engines (AST rules, dataflow families, the
+   PR-8 call graph) and are all caught by the CFG walk.
+4. **CLI surface** -- ``--format sarif`` emits a valid SARIF 2.1.0
+   log carrying the findings; ``--profile`` reports the lifecycle
+   family's cost.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from analytics_zoo_tpu.analysis import run_zoolint
+from analytics_zoo_tpu.analysis.cfg import (
+    build_cfg, default_may_raise, iter_paths)
+from analytics_zoo_tpu.analysis.concurrency import ConcurrencyChecker
+from analytics_zoo_tpu.analysis.config_keys import ConfigKeyChecker
+from analytics_zoo_tpu.analysis.deep_rules import DeepChecker
+from analytics_zoo_tpu.analysis.hygiene import HygieneChecker
+from analytics_zoo_tpu.analysis.lifecycle_rules import LifecycleChecker
+from analytics_zoo_tpu.analysis.mesh_rules import MeshCollectiveChecker
+from analytics_zoo_tpu.analysis.protocol import ProtocolChecker
+from analytics_zoo_tpu.analysis.trace_hazards import TraceHazardChecker
+from analytics_zoo_tpu.analysis.vocabulary import VocabularyChecker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "scripts", "zoolint.py")
+
+
+def _cfg(code, may_raise=None):
+    """Build the CFG of the first function in ``code``. The default
+    ``may_raise`` is "nothing raises" so structural tests count only
+    the explicit control-flow paths."""
+    tree = ast.parse(textwrap.dedent(code))
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    return build_cfg(fn, may_raise=may_raise or (lambda s: False))
+
+
+def _paths(cfg):
+    return list(iter_paths(cfg))
+
+
+def _kinds(path):
+    return [node.kind for _label, node in path]
+
+
+def lint(tmp_path, code, checkers=None, name="snippet.py"):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    return run_zoolint(
+        [str(tmp_path)],
+        checkers=checkers if checkers is not None
+        else [LifecycleChecker()],
+        repo_root=str(tmp_path))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ===================================================================== #
+# layer 1: CFG construction                                             #
+# ===================================================================== #
+class TestCFGConstruction:
+    def test_linear_body_is_one_path(self):
+        g = _cfg("""
+            def f():
+                a = 1
+                b = a + 1
+                return b
+            """)
+        ps = _paths(g)
+        assert len(ps) == 1
+        assert _kinds(ps[0])[-1] == "exit"
+
+    def test_if_else_is_two_paths(self):
+        g = _cfg("""
+            def f(c):
+                if c:
+                    a = 1
+                else:
+                    a = 2
+                return a
+            """)
+        assert len(_paths(g)) == 2
+
+    def test_if_without_else_still_has_fallthrough_path(self):
+        g = _cfg("""
+            def f(c):
+                a = 0
+                if c:
+                    a = 1
+                return a
+            """)
+        labels = [[lab for lab, _ in p] for p in _paths(g)]
+        assert len(labels) == 2
+        assert any("false" in ls for ls in labels)
+
+    def test_early_return_splits_paths(self):
+        g = _cfg("""
+            def f(c):
+                if c:
+                    return 1
+                return 2
+            """)
+        ps = _paths(g)
+        assert len(ps) == 2
+        assert all(_kinds(p)[-1] == "exit" for p in ps)
+
+    def test_while_loop_yields_zero_and_one_iteration(self):
+        g = _cfg("""
+            def f(n):
+                while n:
+                    n = n - 1
+                return n
+            """)
+        ps = _paths(g)
+        # the zero-iteration path skips the body; the one-iteration
+        # path takes the back edge exactly once
+        assert len(ps) == 2
+        bodies = [sum(1 for lab, _ in p if lab == "back") for p in ps]
+        assert sorted(bodies) == [0, 1]
+
+    def test_for_loop_back_edge(self):
+        g = _cfg("""
+            def f(xs):
+                out = 0
+                for x in xs:
+                    out = out + x
+                return out
+            """)
+        ps = _paths(g)
+        assert len(ps) == 2
+        assert any(lab == "back" for p in ps for lab, _ in p)
+
+    def test_while_true_exits_only_via_break(self):
+        g = _cfg("""
+            def f(q):
+                while True:
+                    if q:
+                        break
+                return 1
+            """)
+        for p in _paths(g):
+            assert _kinds(p)[-1] == "exit"
+        # no "false" edge out of the always-true header
+        assert all(lab != "false" or node.kind != "loop"
+                   for p in _paths(g) for lab, node in p)
+
+    def test_continue_routes_back_to_header(self):
+        g = _cfg("""
+            def f(xs):
+                n = 0
+                for x in xs:
+                    if x < 0:
+                        continue
+                    n = n + 1
+                return n
+            """)
+        assert len(_paths(g)) >= 3  # skip, continue-iter, count-iter
+
+    def test_finally_runs_after_return(self):
+        g = _cfg("""
+            def f(r):
+                try:
+                    return use(r)
+                finally:
+                    close(r)
+            """)
+        for p in _paths(g):
+            kinds = _kinds(p)
+            if "exit" != kinds[-1]:
+                continue
+            # the finally anchor must appear on the return route
+            assert "finally" in kinds
+
+    def test_raise_reaches_raise_exit(self):
+        g = _cfg("""
+            def f():
+                raise ValueError("boom")
+            """)
+        ps = _paths(g)
+        assert len(ps) == 1
+        assert _kinds(ps[0])[-1] == "raise-exit"
+
+    def test_with_unwind_on_exception_path(self):
+        g = _cfg("""
+            def f(lock):
+                with lock:
+                    raise RuntimeError("boom")
+            """)
+        (p,) = _paths(g)
+        kinds = _kinds(p)
+        assert kinds[-1] == "raise-exit"
+        # the __exit__ anchor runs before the exception leaves
+        assert "with-exit" in kinds
+
+    def test_catch_all_handler_stops_propagation(self):
+        g = _cfg("""
+            def f():
+                try:
+                    raise ValueError("boom")
+                except Exception:
+                    return 0
+            """)
+        assert all(_kinds(p)[-1] == "exit" for p in _paths(g))
+
+    def test_narrow_handler_keeps_outward_edge(self):
+        # ``except ValueError`` is not a catch-all: the raise may be
+        # a different type at runtime, so a raise-exit path survives
+        g = _cfg("""
+            def f():
+                try:
+                    raise ValueError("boom")
+                except ValueError:
+                    return 0
+            """)
+        ends = {_kinds(p)[-1] for p in _paths(g)}
+        assert ends == {"exit", "raise-exit"}
+
+    def test_mayraise_edge_added_for_calls(self):
+        g = _cfg("""
+            def f(x):
+                y = work(x)
+                return y
+            """, may_raise=default_may_raise)
+        ends = {_kinds(p)[-1] for p in _paths(g)}
+        assert ends == {"exit", "raise-exit"}
+
+    def test_overflow_returns_none(self):
+        # 40 nested try/finally around a return: every crossing
+        # duplicates every finally body -- the cap must kick in
+        code = "def f():\n"
+        for i in range(40):
+            code += "    " * (i + 1) + "try:\n"
+        code += "    " * 41 + "return 1\n"
+        for i in range(40, 0, -1):
+            code += "    " * i + "finally:\n"
+            code += "    " * (i + 1) + f"x{i} = {i}\n"
+        fn = ast.parse(code).body[0]
+        assert build_cfg(fn, max_nodes=50) is None
+        # at the default cap this function still builds fine
+        assert build_cfg(fn) is not None
+
+
+# ===================================================================== #
+# layer 2: TP/FP pairs per rule                                         #
+# ===================================================================== #
+class TestResourcePairing:
+    def test_leak_on_early_return_path(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Pool:
+                def grab(self, cache, cond):
+                    slot = cache.admit(4)
+                    if cond:
+                        return None
+                    cache.release(slot)
+                    return slot
+            """)
+        assert rules_of(fs) == ["leak-on-path"]
+
+    def test_release_on_every_path_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Pool:
+                def grab(self, cache, cond):
+                    slot = cache.admit(4)
+                    if cond:
+                        cache.release(slot)
+                        return None
+                    cache.release(slot)
+                    return slot
+            """)
+        assert fs == []
+
+    def test_ownership_transfer_via_return_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Pool:
+                def grab(self, cache):
+                    slot = cache.admit(4)
+                    return slot
+            """)
+        assert fs == []
+
+    def test_ownership_transfer_into_instance_table_is_clean(
+            self, tmp_path):
+        fs = lint(tmp_path, """
+            class Pool:
+                def grab(self, cache, stream):
+                    slot = cache.admit(4)
+                    self._streams[slot] = stream
+                    return 0
+            """)
+        assert fs == []
+
+    def test_double_release(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Pool:
+                def retire(self, cache):
+                    slot = cache.admit(4)
+                    cache.release(slot)
+                    cache.release(slot)
+            """)
+        assert "double-release" in rules_of(fs)
+
+    def test_release_in_both_branch_arms_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Pool:
+                def retire(self, cache, cond):
+                    slot = cache.admit(4)
+                    if cond:
+                        cache.release(slot)
+                    else:
+                        cache.release(slot)
+            """)
+        assert fs == []
+
+    def test_release_unacquired_on_path(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Pool:
+                def retire(self, cache, cond):
+                    if cond:
+                        slot = cache.admit(4)
+                        return slot
+                    cache.release(slot)
+            """)
+        assert "release-unacquired" in rules_of(fs)
+
+    def test_release_of_param_handle_is_callers_business(
+            self, tmp_path):
+        # releasing a handle the caller passed in is the helper
+        # idiom, not a bug -- params are never "unacquired"
+        fs = lint(tmp_path, """
+            class Pool:
+                def _fail(self, cache, slot):
+                    cache.release(slot)
+            """)
+        assert fs == []
+
+    def test_lock_held_across_early_return(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Buf:
+                def flush(self):
+                    self.lock.acquire()
+                    if not self.dirty:
+                        return 0
+                    n = self.drain()
+                    self.lock.release()
+                    return n
+            """)
+        assert "leak-on-path" in rules_of(fs)
+
+    def test_lock_with_statement_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Buf:
+                def flush(self):
+                    with self.lock:
+                        if not self.dirty:
+                            return 0
+                        return self.drain()
+            """)
+        assert fs == []
+
+    def test_conditional_acquire_idiom_is_clean(self, tmp_path):
+        # ``if not lock.acquire(blocking=False)`` -- the acquire in a
+        # branch test is conservatively untracked (its success is the
+        # branch condition, which the walker cannot model)
+        fs = lint(tmp_path, """
+            class Buf:
+                def try_flush(self):
+                    if not self.lock.acquire(blocking=False):
+                        return 0
+                    n = self.drain()
+                    self.lock.release()
+                    return n
+            """)
+        assert fs == []
+
+    def test_thread_spawned_and_never_joined(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Fleet:
+                def kick(self, fn):
+                    t = threading.Thread(target=fn)
+                    t.start()
+                    return 0
+            """)
+        assert "leak-on-path" in rules_of(fs)
+
+    def test_daemon_thread_is_exempt(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Fleet:
+                def kick(self, fn):
+                    t = threading.Thread(target=fn, daemon=True)
+                    t.start()
+                    return 0
+            """)
+        assert fs == []
+
+    def test_thread_stored_on_self_is_transferred(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Fleet:
+                def kick(self, fn):
+                    self._worker = threading.Thread(target=fn)
+                    self._worker.start()
+                    return 0
+            """)
+        assert fs == []
+
+    def test_bare_warming_scope_never_exited(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Svc:
+                def boot(self):
+                    warming()
+                    self.model.load()
+            """)
+        assert "leak-on-path" in rules_of(fs)
+        assert any("with" in f.message for f in fs)
+
+    def test_warming_as_context_manager_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Svc:
+                def boot(self):
+                    with warming():
+                        self.model.load()
+            """)
+        assert fs == []
+
+    def test_interprocedural_release_through_helper(self, tmp_path):
+        # the helper releases its param; the PR-8 call edge carries
+        # that summary back to the acquire site
+        fs = lint(tmp_path, """
+            class Pool:
+                def _fail(self, slot):
+                    self.cache.release(slot)
+
+                def grab(self, cond):
+                    slot = self.cache.admit(4)
+                    if cond:
+                        self._fail(slot)
+                        return None
+                    self.cache.release(slot)
+                    return slot
+            """)
+        assert fs == []
+
+    def test_suppression_comment_silences_rule(self, tmp_path):
+        # leak findings anchor at the ACQUIRE (the site that names the
+        # owner), so an intentional ownership transfer is annotated
+        # there -- not at whichever return leaks
+        fs = lint(tmp_path, """
+            class Pool:
+                def grab(self, cache, cond):
+                    slot = cache.admit(4)  # zoolint: disable=leak-on-path
+                    if cond:
+                        return None
+                    cache.release(slot)
+                    return slot
+            """)
+        assert fs == []
+
+
+class TestExactlyOnceReply:
+    def test_silent_drop_path_is_reply_missing(self, tmp_path):
+        fs = lint(tmp_path, """
+            ZOOLINT_REPLY_OBLIGATED = ("Stage._handle",)
+
+            class Stage:
+                def _handle(self, blob):
+                    uri, reply = self._decode(blob)
+                    if not uri:
+                        return 0
+                    self._push(uri, reply, b"ok")
+                    return 1
+            """)
+        assert rules_of(fs) == ["reply-missing-on-path"]
+
+    def test_error_reply_on_every_path_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            ZOOLINT_REPLY_OBLIGATED = ("Stage._handle",)
+
+            class Stage:
+                def _handle(self, blob):
+                    uri, reply = self._decode(blob)
+                    if not uri:
+                        self._push_error(uri, reply, "bad request")
+                        return 0
+                    self._push(uri, reply, b"ok")
+                    return 1
+            """)
+        assert fs == []
+
+    def test_requeue_counts_as_resolution(self, tmp_path):
+        fs = lint(tmp_path, """
+            ZOOLINT_REPLY_OBLIGATED = ("Stage._handle",)
+
+            class Stage:
+                def _handle(self, blob):
+                    uri, reply = self._decode(blob)
+                    if self.overloaded:
+                        self.queue.requeue(uri)
+                        return 0
+                    self._push(uri, reply, b"ok")
+                    return 1
+            """)
+        assert fs == []
+
+    def test_handoff_into_instance_container_resolves(self, tmp_path):
+        fs = lint(tmp_path, """
+            ZOOLINT_REPLY_OBLIGATED = ("Stage._handle",)
+
+            class Stage:
+                def _handle(self, blob):
+                    rec = self._decode(blob)
+                    self._inflight.append(rec)
+                    return 0
+            """)
+        assert fs == []
+
+    def test_two_distinct_push_sites_on_one_path(self, tmp_path):
+        fs = lint(tmp_path, """
+            ZOOLINT_REPLY_OBLIGATED = ("Stage._handle",)
+
+            class Stage:
+                def _handle(self, uri, reply):
+                    self._push(uri, reply, b"a")
+                    if self.verbose:
+                        self._push(uri, reply, b"b")
+                    return 1
+            """)
+        assert "reply-duplicated-on-path" in rules_of(fs)
+
+    def test_per_batch_reply_loop_is_not_a_duplicate(self, tmp_path):
+        # the _predict_group shape: one push per request via a loop --
+        # the same SITE re-fires per batch element, which must not
+        # read as a duplicate reply for one request
+        fs = lint(tmp_path, """
+            ZOOLINT_REPLY_OBLIGATED = ("Stage._handle",)
+
+            class Stage:
+                def _handle(self, batch):
+                    for uri, reply, msg in batch:
+                        self._push_error(uri, reply, msg)
+                    return len(batch)
+            """)
+        assert fs == []
+
+    def test_exception_paths_are_exempt(self, tmp_path):
+        # the supervisor's crash requeue covers raise exits; only
+        # NORMAL exits owe a reply
+        fs = lint(tmp_path, """
+            ZOOLINT_REPLY_OBLIGATED = ("Stage._handle",)
+
+            class Stage:
+                def _handle(self, blob):
+                    uri, reply = self._decode(blob)
+                    body = self.model.predict(blob)
+                    self._push(uri, reply, body)
+                    return 1
+            """)
+        assert fs == []
+
+    def test_undeclared_methods_are_not_checked(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Stage:
+                def helper(self, blob):
+                    return 0
+            """)
+        assert fs == []
+
+
+class TestFinallyHygiene:
+    def test_happy_path_only_cleanup(self, tmp_path):
+        # the release exists but an implicit exception edge from the
+        # work call skips it: a softer verdict than leak-on-path
+        # because the fix is "move it into finally", not "add one"
+        fs = lint(tmp_path, """
+            class Pool:
+                def serve(self, cache):
+                    slot = cache.admit(4)
+                    out = self.step(slot)
+                    cache.release(slot)
+                    return out
+            """)
+        assert rules_of(fs) == ["cleanup-not-in-finally"]
+
+    def test_cleanup_in_finally_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Pool:
+                def serve(self, cache):
+                    slot = cache.admit(4)
+                    try:
+                        return self.step(slot)
+                    finally:
+                        cache.release(slot)
+            """)
+        assert fs == []
+
+    def test_except_reraise_cleanup_is_clean(self, tmp_path):
+        # the PR-12 dogfood fix shape: release in a BaseException
+        # handler that re-raises covers the exception path exactly
+        fs = lint(tmp_path, """
+            class Pool:
+                def serve(self, cache):
+                    slot = cache.admit(4)
+                    try:
+                        out = self.step(slot)
+                    except BaseException:
+                        cache.release(slot)
+                        raise
+                    cache.release(slot)
+                    return out
+            """)
+        assert fs == []
+
+
+# ===================================================================== #
+# layer 3: patterns the prior engines provably miss                     #
+# ===================================================================== #
+class TestPriorEnginesMissLifecycle:
+    """THE ISSUE-12 acceptance test: every fixture is the minimal form
+    of a hazard from this repo's own history, and every one is
+    invisible to the AST/dataflow/callgraph engines because they are
+    path-INsensitive -- the release/reply call *exists* in each
+    function; it is just not reachable on every path.
+
+    1. the PR-10 admit slot leak, verbatim shape: ``slot, tok0 =
+       engine.admit(...)`` then a tracer/inflight/stream-allocation
+       window that can raise before the stream table takes ownership
+       (fixed in this PR with a BaseException guard);
+    2. the early-return slot leak: refusal path returns before the
+       release that the happy path runs;
+    3. double-release: a retire helper that frees the same slot twice
+       on one path (the runtime symptom was a *different* stream's
+       pages being freed -- PR 10's review);
+    4. a mutex held across an early return (deadlock on the next
+       caller);
+    5. a silent request drop in a declared reply-obligated stage
+       method (the exactly-once ledger's static twin).
+    """
+
+    FIXTURE = """
+        import threading
+
+        ZOOLINT_REPLY_OBLIGATED = ("Engine._handle_blob",)
+
+
+        class Engine:
+            # 1. PR-10 verbatim: everything between admit() and the
+            #    stream-table store can raise; nothing owns the slot
+            #    until self._streams[slot] runs
+            def admit_prefix(self, prompt, max_toks, uri, reply,
+                             trace, t0):
+                slot, tok0 = self.engine.admit(prompt, max_toks)
+                if trace:
+                    get_tracer().add_span("gen_prefill", trace, t0)
+                get_inflight().add((uri,))
+                stream = _GenStream(uri, reply, trace)
+                self._streams[slot] = stream
+                return self._accept_token(slot, stream, tok0)
+
+            # 2. refusal path returns before the happy-path release
+            def serve_once(self, cache, blob):
+                slot = cache.admit(blob)
+                if self.draining:
+                    return None
+                out = self.result_of(slot)
+                cache.release(slot)
+                return out
+
+            # 3. both branches converge on a second release
+            def retire(self, cache):
+                slot = cache.admit(self.pending)
+                cache.release(slot)
+                if self.verbose:
+                    self.note_retired(slot)
+                cache.release(slot)
+                return 0
+
+            # 4. mutex held across the not-dirty early return
+            def flush(self):
+                self.lock.acquire()
+                if not self.dirty:
+                    return 0
+                n = len(self.buf)
+                self.lock.release()
+                return n
+
+            # 5. the undecodable-request branch drops the request
+            #    without reply, error-reply, or requeue
+            def _handle_blob(self, blob):
+                uri, reply = self.decode(blob)
+                if uri is None:
+                    return 0
+                self._push(uri, reply, self.answer(blob))
+                return 1
+        """
+
+    def prior_engines(self):
+        return [TraceHazardChecker(), ConcurrencyChecker(),
+                ConfigKeyChecker(), VocabularyChecker(),
+                HygieneChecker(), MeshCollectiveChecker(),
+                ProtocolChecker(), DeepChecker()]
+
+    def test_prior_engines_miss_all_of_them(self, tmp_path):
+        fs = lint(tmp_path, self.FIXTURE, self.prior_engines())
+        assert fs == [], [f.render() for f in fs]
+
+    def test_cfg_engine_catches_every_pattern(self, tmp_path):
+        fs = lint(tmp_path, self.FIXTURE)
+        by_fn = {}
+        for f in fs:
+            for fn in ("admit_prefix", "serve_once", "retire",
+                       "flush", "_handle_blob"):
+                if fn in f.message:
+                    by_fn.setdefault(fn, set()).add(f.rule)
+        assert "leak-on-path" in by_fn.get("admit_prefix", set()), fs
+        assert "leak-on-path" in by_fn.get("serve_once", set()), fs
+        assert "double-release" in by_fn.get("retire", set()), fs
+        assert "leak-on-path" in by_fn.get("flush", set()), fs
+        assert ("reply-missing-on-path"
+                in by_fn.get("_handle_blob", set())), fs
+        # >= 4 distinct historical patterns, PR-10 verbatim included
+        assert len(by_fn) == 5
+
+
+# ===================================================================== #
+# layer 4: CLI surface (--format sarif, --profile)                      #
+# ===================================================================== #
+def _run_cli(args, cwd):
+    return subprocess.run([sys.executable, CLI] + args, cwd=cwd,
+                          capture_output=True, text=True, timeout=120)
+
+
+class TestLifecycleCLI:
+    PROBE = textwrap.dedent("""
+        class Pool:
+            def grab(self, cache, cond):
+                slot = cache.admit(4)
+                if cond:
+                    return None
+                cache.release(slot)
+                return slot
+        """)
+
+    def test_sarif_output_carries_findings(self, tmp_path):
+        (tmp_path / "probe.py").write_text(self.PROBE)
+        r = _run_cli(["--no-baseline", "--format", "sarif",
+                      str(tmp_path / "probe.py")], str(tmp_path))
+        assert r.returncode == 1, r.stderr
+        log = json.loads(r.stdout)
+        assert log["version"] == "2.1.0"
+        run = log["runs"][0]
+        assert run["tool"]["driver"]["name"] == "zoolint"
+        rule_ids = {x["id"] for x in run["tool"]["driver"]["rules"]}
+        assert "leak-on-path" in rule_ids
+        results = run["results"]
+        assert any(x["ruleId"] == "leak-on-path"
+                   and x["level"] == "error"
+                   and x["baselineState"] == "new"
+                   and x["locations"][0]["physicalLocation"]
+                       ["region"]["startLine"] > 0
+                   for x in results), results
+
+    def test_sarif_clean_tree_is_valid_and_exit_zero(self, tmp_path):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        r = _run_cli(["--no-baseline", "--format", "sarif",
+                      str(tmp_path / "ok.py")], str(tmp_path))
+        assert r.returncode == 0, r.stderr
+        log = json.loads(r.stdout)
+        assert log["runs"][0]["results"] == []
+
+    def test_profile_reports_lifecycle_family(self, tmp_path):
+        (tmp_path / "probe.py").write_text(self.PROBE)
+        r = _run_cli(["--no-baseline", "--profile",
+                      str(tmp_path / "probe.py")], str(tmp_path))
+        assert "lifecycle" in r.stderr
+        assert "parse" in r.stderr
+        # stdout stays the normal text report
+        assert "leak-on-path" in r.stdout
