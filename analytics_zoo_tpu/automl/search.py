@@ -5,10 +5,13 @@ ray_tune_search_engine.py:32-471 -- tune.run over a Trainable that
 fit_evals a model per sampled config). The TPU redesign schedules trials
 itself: configs come from :mod:`space` expansion, each trial runs a
 picklable ``trial_fn(config, data) -> {"reward_metric", "state"}`` either
-in-process (``executor="sequential"``) or on a spawn-context process pool
-(``executor="process"``). Trial processes are pinned to the CPU backend
-via JAX_PLATFORMS so a fleet of small searches never contends for the
-TPU chip -- the chip belongs to the final refit/serving path.
+in-process (``executor="sequential"``), on a spawn-context process pool
+(``executor="process"``), or as lanes of a vmapped population cohort
+(``executor="vectorized"``, :mod:`automl.vectorized` -- shape-compatible
+configs train as ONE compiled program). Pool trial processes are pinned
+to the CPU backend via JAX_PLATFORMS so a fleet of small searches never
+contends for the TPU chip -- the chip belongs to the final refit/serving
+path; the vectorized executor is the opposite bet, made for the chip.
 """
 
 from __future__ import annotations
@@ -21,8 +24,17 @@ from typing import Any, Callable, Dict, List, Optional
 from analytics_zoo_tpu.automl import metrics as automl_metrics
 from analytics_zoo_tpu.automl.space import expand_and_sample
 from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.obs.events import emit
+from analytics_zoo_tpu.obs.metrics import get_registry
 
 logger = get_logger(__name__)
+
+_M_TRIALS = get_registry().counter(
+    "zoo_automl_trials_total",
+    "Search trials completed, by outcome", labelnames=("outcome",))
+_M_SEARCHES = get_registry().counter(
+    "zoo_automl_searches_total",
+    "Searches run, by stop reason", labelnames=("reason",))
 
 
 @dataclass
@@ -82,7 +94,11 @@ class SearchEngine:
     """compile() -> run() -> get_best_trials(k).
 
     Args:
-      executor: "sequential" (in-process) or "process" (spawn pool).
+      executor: "sequential" (in-process), "process" (spawn pool), or
+        "vectorized" (shape-compatible configs train as lanes of one
+        vmapped population -- :mod:`automl.vectorized`; requires a
+        trial_fn with a cohort-runner form, e.g. the built-in
+        ``time_sequence_trial``).
       max_workers: pool width for the process executor.
       logs_dir: when set, each trial's reward lands in a TensorBoard
         event file (ref: automl/logger/tensorboardxlogger.py).
@@ -101,8 +117,9 @@ class SearchEngine:
                  logs_dir: Optional[str] = None, name: str = "automl",
                  scheduler: str = "fifo", reduction_factor: int = 4,
                  grace_epochs: int = 1):
-        if executor not in ("sequential", "process"):
-            raise ValueError("executor must be sequential|process")
+        if executor not in ("sequential", "process", "vectorized"):
+            raise ValueError(
+                "executor must be sequential|process|vectorized")
         if scheduler not in ("fifo", "asha"):
             raise ValueError("scheduler must be fifo|asha")
         if reduction_factor < 2:
@@ -122,6 +139,13 @@ class SearchEngine:
         self.trials: List[TrialOutput] = []
         self.stop: Optional[Dict[str, Any]] = None
         self.total_trial_epochs = 0
+        # why the last run() ended: "reward" | "total_epochs" (a stop
+        # criterion tripped) or "exhausted" (every config ran). The
+        # total_epochs cap is checked BETWEEN work units, so the unit
+        # in flight when it trips (one trial on fifo, one rung on
+        # asha) completes -- the spend overshoots by up to that unit.
+        self.stopped_reason: Optional[str] = None
+        self._vec_runner = None
 
     # ----------------------------------------------------------- setup --
     def compile(self, data: Any, trial_fn: Callable, recipe=None,
@@ -164,6 +188,15 @@ class SearchEngine:
         self.configs = expand_and_sample(search_space,
                                          num_samples=num_samples,
                                          seed=seed)
+        if self.executor == "vectorized":
+            from analytics_zoo_tpu.automl.vectorized import make_runner
+
+            self._vec_runner = make_runner(trial_fn, data)
+            if self._vec_runner is None:
+                raise ValueError(
+                    "executor='vectorized' needs a trial_fn with a "
+                    "cohort-runner form (time_sequence_trial, or a "
+                    "trial_fn exposing .cohort_runner(data, trial_fn))")
         logger.info("search compiled: %d trials", len(self.configs))
 
     # ------------------------------------------------------------- run --
@@ -171,12 +204,29 @@ class SearchEngine:
         if self.trial_fn is None:
             raise RuntimeError("compile() first")
         self.total_trial_epochs = 0
+        self.stopped_reason = "exhausted"
+        if self._vec_runner is not None:
+            self._vec_runner.reset()
+        emit("automl_search_start", "automl", name=self.name,
+             trials=len(self.configs), executor=self.executor,
+             scheduler=self.scheduler)
         if self.scheduler == "asha" and len(self.configs) > 1:
             self.trials = self._run_asha()
         else:
             self.trials = self._run_fifo()
         self._log_trials()
+        for i, t in enumerate(self.trials):
+            _M_TRIALS.labels(
+                outcome="error" if t.error is not None else "ok").inc()
+            emit("automl_search_trial", "automl", name=self.name,
+                 index=i, ok=t.error is None, reward=t.reward,
+                 rung=t.extras.get("rung"))
         ok = [t for t in self.trials if t.error is None]
+        _M_SEARCHES.labels(reason=self.stopped_reason).inc()
+        emit("automl_search_stop", "automl", name=self.name,
+             reason=self.stopped_reason, trials=len(self.trials),
+             failed=len(self.trials) - len(ok),
+             total_epochs=self.total_trial_epochs)
         if not ok:
             errors = "; ".join((t.error or "").splitlines()[0]
                                for t in self.trials[:3])
@@ -196,6 +246,7 @@ class SearchEngine:
         i = 0
         while i < len(self.configs):
             if self._epoch_cap_reached():
+                self.stopped_reason = "total_epochs"
                 logger.info("fifo: total_epochs cap reached after %d "
                             "trials", i)
                 break
@@ -206,6 +257,7 @@ class SearchEngine:
             i += len(chunk)
             if self._reward_reached(
                     [t.reward for t in outs if t.error is None]):
+                self.stopped_reason = "reward"
                 logger.info("fifo: reward target reached after %d "
                             "trials", i)
                 break
@@ -271,10 +323,15 @@ class SearchEngine:
             logger.info("asha rung %d (%d epochs): %d/%d trials, "
                         "best %s=%.6g", rung, budget, len(scored),
                         len(alive), self.metric, scored[0][0])
-            if final or self._reward_reached([scored[0][0]])                     or self._epoch_cap_reached():
-                if not final:
-                    logger.info("asha: stop criteria met at rung %d",
-                                rung)
+            if final:
+                break
+            if self._reward_reached([scored[0][0]]):
+                self.stopped_reason = "reward"
+                logger.info("asha: stop criteria met at rung %d", rung)
+                break
+            if self._epoch_cap_reached():
+                self.stopped_reason = "total_epochs"
+                logger.info("asha: stop criteria met at rung %d", rung)
                 break
             keep = max(1, math.ceil(len(scored) / rf))
             alive = [i for _, i in scored[:keep]]
@@ -293,6 +350,10 @@ class SearchEngine:
 
     def _run_trials(self, configs: List[Dict[str, Any]]
                     ) -> List[TrialOutput]:
+        if not configs:
+            return []
+        if self.executor == "vectorized":
+            return self._vec_runner.run_trials(configs)
         if self.executor == "process" and len(configs) > 1:
             return self._run_pool(configs)
         return [_trial_entry(self.trial_fn, c, self.data)
@@ -309,11 +370,34 @@ class SearchEngine:
                                  initializer=_init_cpu_worker,
                                  initargs=(self.data,)) as pool:
             # dataset ships once per worker via the initializer; each
-            # submit carries only the config + the sentinel
-            futures = [pool.submit(_trial_entry, self.trial_fn, c,
-                                   _FROM_WORKER)
-                       for c in configs]
-            return [f.result() for f in futures]
+            # submit carries only the config + the sentinel. A config
+            # the spawn pickler cannot serialize fails in the queue
+            # feeder AFTER submit() returns -- the executor parks the
+            # error on that one future (it never reaches _trial_entry's
+            # in-worker catch), so both submit() and result() get a
+            # per-trial catch: one poisoned config must not sink the
+            # wave.
+            outs: List[Optional[TrialOutput]] = [None] * len(configs)
+            futures = []
+            for i, c in enumerate(configs):
+                try:
+                    futures.append(
+                        (i, pool.submit(_trial_entry, self.trial_fn, c,
+                                        _FROM_WORKER)))
+                except Exception as e:
+                    outs[i] = TrialOutput(
+                        config=c,
+                        error=f"trial submission failed: "
+                              f"{type(e).__name__}: {e}")
+            for i, f in futures:
+                try:
+                    outs[i] = f.result()
+                except Exception as e:
+                    outs[i] = TrialOutput(
+                        config=configs[i],
+                        error=f"trial did not reach the worker "
+                              f"({type(e).__name__}): {e}")
+            return outs
 
     def _log_trials(self) -> None:
         for i, t in enumerate(self.trials):

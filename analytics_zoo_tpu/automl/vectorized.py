@@ -1,0 +1,313 @@
+"""Vectorized AutoML executor: trial cohorts as ONE compiled population.
+
+The ``executor="vectorized"`` backend for :class:`SearchEngine`. Where
+the process executor ships every sampled config to its own CPU worker
+(the reference's one-trial-per-Ray-worker shape), this backend
+partitions configs into *shape-compatible cohorts* -- same architecture
+hyperparameters, same rolled feature shapes, same effective batch size
+-- and trains each cohort as a single
+:class:`~analytics_zoo_tpu.learn.population.PopulationEstimator`: one
+jitted vmapped step, per-lane learning rates, per-lane epoch budgets.
+
+ASHA integration is *masking in place*: rungs re-enter ``run_trials``
+with the surviving configs at a larger epoch budget, and the runner
+CONTINUES the cohort's population from its previous rung state with the
+culled lanes frozen (zero effective lr, held optimizer state). Because
+the per-lane trajectory is deterministic (same PRNG stream, same
+epoch-seeded shuffle), continuing rung r's state to rung r+1's budget
+produces exactly the model a from-scratch run at the larger budget
+would -- the sequential scheduler's re-train-from-scratch semantics,
+without the recompute, and with NO shape change across rungs (zero
+recompiles -- the acceptance gate the recompile-storm detector checks).
+
+Configs the cohort model cannot absorb -- XGBoost-family trials, or an
+unknown model key -- fall back to the in-process sequential path per
+config (``zoo.automl.vectorized.fallback``), so mixed search spaces
+still complete.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.obs.events import emit
+from analytics_zoo_tpu.obs.metrics import get_registry
+
+logger = get_logger(__name__)
+
+_M_COHORTS = get_registry().counter(
+    "zoo_automl_cohorts_total",
+    "Vectorized trial cohorts trained (one compiled population each)")
+_M_VEC_TRIALS = get_registry().counter(
+    "zoo_automl_vectorized_trials_total",
+    "Trials answered by the vectorized executor, by path",
+    labelnames=("path",))
+
+# config keys that select the training loop / data rolling, not the
+# stacked parameter tree: cohort membership must ignore them ("lr" is
+# a traced per-lane scalar; "selected_features" changes which columns
+# roll into x, which the data-shape part of the key already captures)
+_NON_ARCH_KEYS = ("lr", "epochs", "batch_size", "metric",
+                  "selected_features")
+
+# model families build_forecast_module can turn into one flax module
+_NEURAL_FAMILIES = ("LSTM", "VANILLALSTM", "SEQ2SEQ", "MTNET", "TCN")
+
+
+def _identity(config: Dict[str, Any]) -> Tuple:
+    """Stable identity of a trial config MINUS its epoch budget --
+    the key that maps an ASHA rung's config back to the lane its
+    earlier rung trained (rungs differ only in ``epochs``)."""
+    return tuple(sorted((k, repr(v)) for k, v in config.items()
+                        if k != "epochs"))
+
+
+def _arch_key(config: Dict[str, Any]) -> Tuple:
+    return tuple(sorted((k, repr(v)) for k, v in config.items()
+                        if k not in _NON_ARCH_KEYS))
+
+
+class _Cohort:
+    """One live population + the per-lane data/scoring context."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.pop = None                  # PopulationEstimator
+        self.lanes: List[Tuple] = []     # lane -> config identity
+        self.ran: List[int] = []         # lane -> epochs trained so far
+        self.preps: List[Dict] = []      # lane -> prepared trial data
+        self.x = None                    # stacked [N, B, T, F]
+        self.y = None                    # stacked [N, B, out]
+        self.batch_size = 0
+
+
+class TimeSeriesCohortRunner:
+    """Cohort execution for ``time_sequence_trial`` search spaces.
+
+    Each prepared config carries its OWN feature transform (the
+    sequential trial refits ``TimeSequenceFeatureTransformer`` per
+    config -- ``selected_features``/``past_seq_len`` change the rolled
+    arrays), so a cohort stacks per-member data lanes ``[N, B, T, F]``
+    alongside the stacked parameters: members may read different
+    columns as long as the shapes agree.
+    """
+
+    def __init__(self, data: Dict[str, Any], trial_fn=None):
+        self.data = data
+        self.trial_fn = trial_fn
+        self._cohorts: Dict[Tuple, List[_Cohort]] = {}
+        self._n_created = 0
+
+    def reset(self) -> None:
+        """Drop cached populations (a re-run() must start fresh)."""
+        self._cohorts.clear()
+
+    # ------------------------------------------------------- trial prep --
+    @staticmethod
+    def _vectorizable(config: Dict[str, Any]) -> bool:
+        kind = str(config.get("model", "LSTM")).upper()
+        return kind in _NEURAL_FAMILIES
+
+    def _prepare(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-config feature fit -- the exact data the sequential
+        ``time_sequence_trial`` trains on (parity depends on it)."""
+        from analytics_zoo_tpu.automl.feature import (
+            TimeSequenceFeatureTransformer)
+        from analytics_zoo_tpu.automl.predictor import _unscaler
+
+        spec = self.data["spec"]
+        ft = TimeSequenceFeatureTransformer(**spec)
+        x, y = ft.fit_transform(self.data["train_df"], **config)
+        y2 = np.asarray(y).reshape(len(y), -1)
+        if self.data.get("validation_df") is not None:
+            vx, vy = ft.transform(self.data["validation_df"],
+                                  is_train=True)
+            vy2 = np.asarray(vy).reshape(len(vy), -1)
+        else:
+            vx, vy2 = x, y2
+        batch_size = max(1, min(int(config.get("batch_size", 32)),
+                                len(x)))
+        cohort_key = (_arch_key(config), x.shape, y2.shape, vx.shape,
+                      batch_size)
+        return {"config": dict(config), "ft": ft, "x": x, "y2": y2,
+                "vx": vx, "vy2": vy2, "unscale": _unscaler(ft),
+                "batch_size": batch_size, "cohort_key": cohort_key,
+                "n_targets": len(ft.target_col),
+                "future_seq_len": spec["future_seq_len"]}
+
+    # ---------------------------------------------------------- cohorts --
+    def _new_cohort(self, entries: List[Tuple[int, Dict]]) -> _Cohort:
+        from analytics_zoo_tpu.automl.models import build_forecast_module
+        from analytics_zoo_tpu.learn.population import PopulationEstimator
+
+        self._n_created += 1
+        cohort = _Cohort(f"cohort-{self._n_created}")
+        preps = [p for _, p in entries]
+        first = preps[0]
+        module = build_forecast_module(first["config"],
+                                       first["future_seq_len"],
+                                       first["n_targets"])
+        lrs = [float(p["config"].get("lr", 1e-3)) for p in preps]
+        cohort.pop = PopulationEstimator(module, n_members=len(preps),
+                                         loss="mse", lr=lrs)
+        cohort.lanes = [_identity(p["config"]) for p in preps]
+        cohort.ran = [0] * len(preps)
+        cohort.preps = preps
+        cohort.x = np.stack([p["x"] for p in preps])
+        cohort.y = np.stack([p["y2"] for p in preps])
+        cohort.batch_size = first["batch_size"]
+        return cohort
+
+    def _assign(self, group: List[Tuple[int, Dict]]
+                ) -> List[Tuple[_Cohort, List[Tuple[int, Dict, int]]]]:
+        """Map prepared configs onto existing cohort lanes (ASHA
+        continuation) and gather the rest into new cohorts, capped at
+        ``zoo.automl.vectorized.max_cohort`` lanes each."""
+        key = group[0][1]["cohort_key"]
+        cohorts = self._cohorts.setdefault(key, [])
+        plan: Dict[int, List[Tuple[int, Dict, int]]] = {}
+        leftover: List[Tuple[int, Dict]] = []
+        used: Dict[int, set] = {id(c): set() for c in cohorts}
+        for pos, prep in group:
+            ident = _identity(prep["config"])
+            target = int(prep["config"].get("epochs", 1))
+            placed = False
+            for ci, cohort in enumerate(cohorts):
+                taken = used[id(cohort)]
+                for lane, lid in enumerate(cohort.lanes):
+                    # a lane continues only FORWARD (target epochs past
+                    # what it already trained); an equal target re-scores
+                    # the held state, which is what a from-scratch re-run
+                    # at the same budget would produce anyway
+                    if (lane not in taken and lid == ident
+                            and target >= cohort.ran[lane]):
+                        taken.add(lane)
+                        plan.setdefault(ci, []).append(
+                            (pos, prep, lane))
+                        placed = True
+                        break
+                if placed:
+                    break
+            if not placed:
+                leftover.append((pos, prep))
+        out = [(cohorts[ci], entries) for ci, entries in plan.items()]
+        if leftover:
+            cap = int(get_config().get(
+                "zoo.automl.vectorized.max_cohort", 64))
+            for s in range(0, len(leftover), cap):
+                chunk = leftover[s:s + cap]
+                cohort = self._new_cohort(chunk)
+                cohorts.append(cohort)
+                out.append((cohort,
+                            [(pos, prep, lane) for lane, (pos, prep)
+                             in enumerate(chunk)]))
+        return out
+
+    def _run_cohort(self, cohort: _Cohort,
+                    entries: List[Tuple[int, Dict, int]],
+                    outputs: List) -> None:
+        from analytics_zoo_tpu.automl import metrics as automl_metrics
+        from analytics_zoo_tpu.automl.search import TrialOutput
+
+        n = cohort.pop.n_members
+        budgets = list(cohort.ran)
+        for _, prep, lane in entries:
+            budgets[lane] = int(prep["config"].get("epochs", 1))
+            # continuation reuses the cohort's stored data lanes: the
+            # feature transform is deterministic per config, so the
+            # freshly prepared arrays equal the stored ones
+            cohort.preps[lane] = prep
+        top = max(budgets)
+        continued = cohort.pop.epoch > 0
+        if top > cohort.pop.epoch:
+            cohort.pop.fit(cohort.x, cohort.y, cohort.batch_size,
+                           epochs=top, budgets=budgets)
+        _M_COHORTS.inc()
+        emit("population_cohort", "automl", name=cohort.name,
+             members=n, active=len(entries), epochs=top,
+             continued=continued)
+        vx = np.stack([p["vx"] for p in cohort.preps])
+        preds = cohort.pop.predict(vx)
+        for pos, prep, lane in entries:
+            cfg = prep["config"]
+            metric = str(cfg.get("metric", "mse"))
+            vy, pred = prep["vy2"], preds[lane]
+            unscale = prep["unscale"]
+            vy, pred = unscale(vy), unscale(pred)
+            reward = automl_metrics.evaluate(metric, vy, pred)
+            cohort.ran[lane] = budgets[lane]
+            _M_VEC_TRIALS.labels(path="cohort").inc()
+            outputs[pos] = TrialOutput(
+                config=cfg, reward=float(reward),
+                state=cohort.pop.export_member_bytes(lane),
+                extras={"example_x": prep["x"][:1],
+                        "cohort": cohort.name, "lane": lane})
+
+    # --------------------------------------------------------------- run --
+    def run_trials(self, configs: List[Dict[str, Any]]) -> List:
+        from analytics_zoo_tpu.automl.search import (
+            TrialOutput, _trial_entry)
+
+        outputs: List[Optional[TrialOutput]] = [None] * len(configs)
+        fallback_ok = bool(get_config().get(
+            "zoo.automl.vectorized.fallback", True))
+        groups: Dict[Tuple, List[Tuple[int, Dict]]] = {}
+        for pos, cfg in enumerate(configs):
+            if not self._vectorizable(cfg):
+                _M_VEC_TRIALS.labels(path="fallback").inc()
+                outputs[pos] = _trial_entry(self.trial_fn, cfg,
+                                            self.data)
+                continue
+            try:
+                prep = self._prepare(cfg)
+            except Exception as e:
+                import traceback
+
+                outputs[pos] = TrialOutput(
+                    config=cfg,
+                    error=f"{e}\n{traceback.format_exc()}")
+                continue
+            groups.setdefault(prep["cohort_key"], []).append(
+                (pos, prep))
+        for key, group in groups.items():
+            try:
+                for cohort, entries in self._assign(group):
+                    self._run_cohort(cohort, entries, outputs)
+            except Exception as e:
+                # a cohort failure must not sink the search: answer its
+                # trials through the sequential path (or as errors)
+                logger.exception("vectorized cohort failed: %s", e)
+                for pos, prep in group:
+                    if outputs[pos] is not None:
+                        continue
+                    if fallback_ok:
+                        _M_VEC_TRIALS.labels(path="fallback").inc()
+                        outputs[pos] = _trial_entry(
+                            self.trial_fn, prep["config"], self.data)
+                    else:
+                        import traceback
+
+                        outputs[pos] = TrialOutput(
+                            config=prep["config"],
+                            error=f"{e}\n{traceback.format_exc()}")
+        return outputs
+
+
+def make_runner(trial_fn, data) -> Optional[TimeSeriesCohortRunner]:
+    """Resolve the cohort runner for a trial function. A custom
+    ``trial_fn`` opts in by exposing ``trial_fn.cohort_runner(data,
+    trial_fn)``; the built-in ``time_sequence_trial`` maps to
+    :class:`TimeSeriesCohortRunner`. Returns None when the trial
+    function has no vectorized form."""
+    factory = getattr(trial_fn, "cohort_runner", None)
+    if factory is not None:
+        return factory(data, trial_fn)
+    from analytics_zoo_tpu.automl.predictor import time_sequence_trial
+
+    if trial_fn is time_sequence_trial:
+        return TimeSeriesCohortRunner(data, trial_fn)
+    return None

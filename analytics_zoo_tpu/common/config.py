@@ -188,6 +188,24 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.obs.postmortem.max_events": 512,
     "zoo.obs.recompile.window_s": 60.0,
     "zoo.obs.recompile.threshold": 8,
+    # vectorized population engine (learn/population.py, ISSUE-13):
+    # hard cap on stacked member lanes in one PopulationEstimator (the
+    # whole population is ONE executable; too many lanes silently
+    # multiplies every buffer by N)
+    "zoo.population.max_members": 1024,
+    # vectorized AutoML executor (automl/vectorized.py): max lanes per
+    # cohort (a larger sampled wave splits into several populations),
+    # and whether a failed cohort falls back to answering its trials
+    # through the sequential in-process path (False = surface the
+    # cohort error on every member trial)
+    "zoo.automl.vectorized.max_cohort": 64,
+    "zoo.automl.vectorized.fallback": True,
+    # per-tenant serving lanes (inference/population.py): the lane a
+    # request without __tenant__ uses, unless strict, in which case
+    # tenant-less requests to a population model are rejected with a
+    # structured invalid-request error
+    "zoo.serving.tenant.default_lane": 0,
+    "zoo.serving.tenant.strict": False,
     # inference
     "zoo.inference.default_dtype": "bfloat16",
     # XLA persistent compilation cache (see common.context.
@@ -280,6 +298,11 @@ _SPECS: Dict[str, tuple] = {
     "zoo.generation.max_tokens": ("int", 1, None),
     "zoo.generation.step_idle_ms": ("float", 0, None),
     "zoo.generation.stream_chunk_tokens": ("int", 1, None),
+    "zoo.population.max_members": ("int", 1, None),
+    "zoo.automl.vectorized.max_cohort": ("int", 1, None),
+    "zoo.automl.vectorized.fallback": ("bool",),
+    "zoo.serving.tenant.default_lane": ("int", 0, None),
+    "zoo.serving.tenant.strict": ("bool",),
     "zoo.obs.trace.enabled": ("bool",),
     "zoo.obs.trace.max_spans": ("int", 1, None),
     "zoo.obs.report.interval": ("float", 0, None),

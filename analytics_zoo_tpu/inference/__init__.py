@@ -11,6 +11,9 @@ serves all threads (SURVEY.md section 7 step 7).
 from analytics_zoo_tpu.inference.inference_model import (  # noqa: F401
     InferenceModel,
 )
+from analytics_zoo_tpu.inference.population import (  # noqa: F401
+    PopulationInferenceModel,
+)
 from analytics_zoo_tpu.inference.kv_cache import (  # noqa: F401
     CacheOverflow,
     PagedKVCache,

@@ -1,0 +1,244 @@
+"""PopulationInferenceModel: one warmed compile serves N models.
+
+The serving face of :class:`~analytics_zoo_tpu.learn.population.
+PopulationEstimator` (ISSUE-13): a stacked parameter tree ``[N, ...]``
+behind the ``predict_async`` contract the serving worker dispatches
+through. Two modes:
+
+- ``"tenant"``: the request's ``__tenant__`` wire key selects which
+  member answers. The lane index is a TRACED int32 scalar argument of
+  the jitted apply -- ``tree_map(lambda a: a[lane], variables)`` is a
+  dynamic slice, not a shape -- so every tenant id dispatches through
+  the SAME warmed executable. Thousands of per-tenant fine-tuned
+  variants serve from one compile instead of thousands of deployments.
+- ``"ensemble"``: every member answers the same batch in one vmapped
+  dispatch; the reply carries the population ``mean`` and per-member
+  ``var`` (the variance is the confidence signal the reference model
+  zoo's anomaly-detection scenario thresholds on).
+
+Batching follows :mod:`inference.inference_model`'s idiom: inputs pad
+up to power-of-two buckets, compiled executables cache per bucket
+shape, and compiles feed the recompile-storm detector -- a healthy
+deployment's compile counter stays flat after ``warm_up`` no matter
+how many distinct tenants it answers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.obs.events import record_compile, warming
+from analytics_zoo_tpu.obs.metrics import get_registry
+from analytics_zoo_tpu.serving.protocol import INVALID_PREFIX
+
+logger = get_logger(__name__)
+
+_REG = get_registry()
+_M_SERVE = _REG.counter(
+    "zoo_population_dispatch_total",
+    "Population-model serving dispatches, by mode (tenant/ensemble)",
+    labelnames=("mode",))
+
+
+def _bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class PopulationInferenceModel:
+    """Serve a stacked ``[N, ...]`` parameter tree.
+
+    Args:
+      apply_fn: ``apply_fn(member_variables, x) -> predictions`` for ONE
+        member's (unstacked) variables tree.
+      variables: the member-stacked variables pytree (leading axis N on
+        every leaf).
+      n_members: population size; inferred from the first leaf when
+        omitted.
+      mode: ``"tenant"`` (lane-selected member answers) or
+        ``"ensemble"`` (mean + variance over all members).
+      default_lane / strict: tenant-mode behavior for requests naming
+        no ``__tenant__`` -- answer from ``default_lane``
+        (``zoo.serving.tenant.default_lane``), or refuse with a
+        structured 400 when strict (``zoo.serving.tenant.strict``).
+    """
+
+    def __init__(self, apply_fn: Callable, variables: Any,
+                 n_members: Optional[int] = None, mode: str = "tenant",
+                 default_lane: Optional[int] = None,
+                 strict: Optional[bool] = None):
+        if mode not in ("tenant", "ensemble"):
+            raise ValueError("mode must be tenant|ensemble")
+        cfg = get_config()
+        self._apply_fn = apply_fn
+        self.variables = variables
+        leaves = jax.tree_util.tree_leaves(variables)
+        if not leaves:
+            raise ValueError("population variables tree is empty")
+        self.n_members = (int(n_members) if n_members is not None
+                          else int(leaves[0].shape[0]))
+        self.mode = mode
+        self.default_lane = int(
+            cfg.get("zoo.serving.tenant.default_lane", 0)
+            if default_lane is None else default_lane)
+        self.strict = bool(
+            cfg.get("zoo.serving.tenant.strict", False)
+            if strict is None else strict)
+        # the serving worker keys its tenant routing off this attribute:
+        # set (lane count) = requests may carry __tenant__ and dispatch
+        # passes the resolved lane; None = a tenant-carrying request is
+        # a 400 (ensemble replies aggregate every member, so a lane
+        # selector on one is a client error, not a no-op)
+        self.tenant_lanes = (self.n_members if mode == "tenant"
+                             else None)
+        self._compiled: Dict[Any, Any] = {}
+
+    @classmethod
+    def from_estimator(cls, pop, mode: str = "tenant",
+                       **kwargs) -> "PopulationInferenceModel":
+        """Wrap a trained :class:`PopulationEstimator` without copying
+        its stacked parameters."""
+        if pop.variables is None:
+            raise ValueError("population not built; fit() first")
+        adapter = pop.adapter
+
+        def apply_fn(variables, x):
+            out, _ = adapter.apply(variables, x, training=False)
+            return out
+
+        return cls(apply_fn, pop.variables, n_members=pop.n_members,
+                   mode=mode, **kwargs)
+
+    # ------------------------------------------------------- tenanting --
+    def resolve_lane(self, tenant: Optional[int]) -> Optional[int]:
+        """Map a request's ``__tenant__`` (or None) to a concrete lane.
+        Raises ``ValueError`` with the structured ``invalid_request``
+        prefix -- the serving worker pushes the message as the reply,
+        and the frontend maps it to a 400 -- for an out-of-range lane
+        or a missing tenant under strict mode."""
+        if self.mode != "tenant":
+            return None
+        if tenant is None:
+            if self.strict:
+                raise ValueError(
+                    f"{INVALID_PREFIX}: request names no __tenant__ "
+                    "and zoo.serving.tenant.strict is on")
+            tenant = self.default_lane
+        lane = int(tenant)
+        if not 0 <= lane < self.n_members:
+            raise ValueError(
+                f"{INVALID_PREFIX}: tenant lane {lane} out of range "
+                f"[0, {self.n_members})")
+        return lane
+
+    # --------------------------------------------------------- predict --
+    def _fns(self):
+        """Build the mode's jitted apply once (lane is a traced
+        argument, so ONE executable per input bucket covers every
+        tenant)."""
+        apply_fn = self._apply_fn
+        if self.mode == "tenant":
+
+            def fn(variables, lane, x):
+                member = jax.tree_util.tree_map(
+                    lambda a: a[lane], variables)
+                return apply_fn(member, x)
+
+            return jax.jit(fn)
+
+        def fn(variables, x):
+            preds = jax.vmap(lambda v: apply_fn(v, x))(variables)
+            return {
+                "mean": jax.tree_util.tree_map(
+                    lambda a: a.mean(axis=0), preds),
+                "var": jax.tree_util.tree_map(
+                    lambda a: a.var(axis=0), preds),
+            }
+
+        return jax.jit(fn)
+
+    def predict_async(self, x, lane: Optional[int] = None):
+        """Dispatch without materializing: returns ``(outputs, n)``
+        (the worker's ``predict_async`` contract). ``lane`` is the
+        resolved tenant lane (tenant mode; None resolves through
+        :meth:`resolve_lane`, honoring default/strict)."""
+        def canon(a):
+            a = np.asarray(a)
+            if a.dtype == np.float64:
+                return a.astype(np.float32)
+            if a.dtype == np.int64:
+                return a.astype(np.int32)
+            return a
+
+        x = jax.tree_util.tree_map(canon, x)
+        leaves = jax.tree_util.tree_leaves(x)
+        n = leaves[0].shape[0]
+        bucket = _bucket(n)
+
+        def pad(a):
+            if a.shape[0] == bucket:
+                return a
+            return np.concatenate(
+                [a, np.repeat(a[-1:], bucket - a.shape[0], axis=0)])
+
+        padded = jax.tree_util.tree_map(pad, x)
+        key = tuple((l.shape, str(l.dtype))
+                    for l in jax.tree_util.tree_leaves(padded))
+        fn = self._compiled.get(key)
+        fresh = fn is None
+        if fresh:
+            fn = self._fns()
+            self._compiled[key] = fn
+        _M_SERVE.labels(mode=self.mode).inc()
+        if self.mode == "tenant":
+            if lane is None:
+                lane = self.resolve_lane(None)
+            args = (self.variables, jnp.asarray(lane, jnp.int32),
+                    padded)
+        else:
+            args = (self.variables, padded)
+        if fresh:
+            import time
+
+            t0 = time.perf_counter()
+            out = fn(*args)
+            record_compile("population.serve", key,
+                           time.perf_counter() - t0,
+                           subsystem="inference")
+            return out, n
+        return fn(*args), n
+
+    def predict(self, x, lane: Optional[int] = None):
+        out, n = self.predict_async(x, lane=lane)
+        return jax.tree_util.tree_map(lambda a: np.asarray(a)[:n], out)
+
+    # ---------------------------------------------------------- warmup --
+    def warm_up(self, example_input,
+                batch_sizes: Sequence[int] = (1, 8, 32)
+                ) -> "PopulationInferenceModel":
+        """Pre-compile the request-batch buckets (lane 0 stands in for
+        every tenant: the lane is traced, so warming one lane warms
+        them all)."""
+        example = jax.tree_util.tree_map(
+            np.asarray, example_input,
+            is_leaf=lambda v: isinstance(v, list))
+        done = set()
+        with warming():
+            for bs in batch_sizes:
+                bucket = _bucket(bs)
+                if bucket in done:
+                    continue
+                done.add(bucket)
+                batch = jax.tree_util.tree_map(
+                    lambda a: np.repeat(a[:1], bucket, axis=0), example)
+                lane = 0 if self.mode == "tenant" else None
+                self.predict(batch, lane=lane)
+        return self

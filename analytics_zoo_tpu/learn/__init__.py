@@ -7,6 +7,9 @@ Estimators over five backends -- with one SPMD Estimator.
 
 from analytics_zoo_tpu.learn.estimator import Estimator  # noqa: F401
 from analytics_zoo_tpu.learn.gan import GANEstimator  # noqa: F401
+from analytics_zoo_tpu.learn.population import (  # noqa: F401
+    PopulationEstimator,
+)
 from analytics_zoo_tpu.learn.profiler import TrainingProfiler  # noqa: F401
 from analytics_zoo_tpu.learn import metrics  # noqa: F401
 from analytics_zoo_tpu.learn import objectives  # noqa: F401

@@ -141,6 +141,16 @@ EVENT_TYPES: Dict[str, str] = {
                            "admission: the paged KV cache had no free "
                            "slot/pages (fields: uri, need_pages, "
                            "free_pages, free_slots)",
+    # vectorized population / automl (ISSUE-13)
+    "population_cohort": "a vectorized trial cohort ran as one "
+                         "population dispatch (fields: name, members, "
+                         "active, epochs, continued)",
+    "automl_search_start": "SearchEngine.run() entered (fields: name, "
+                           "trials, executor, scheduler)",
+    "automl_search_trial": "one search trial finished (fields: name, "
+                           "index, ok, reward, rung)",
+    "automl_search_stop": "a search ended (fields: name, reason, "
+                          "trials, failed, total_epochs)",
     # learn lifecycle
     "train_start": "estimator fit() entered (fields: epochs, "
                    "batch_size)",

@@ -47,7 +47,7 @@ from analytics_zoo_tpu.obs.flight import get_inflight
 from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.serving.protocol import (
     DEADLINE_PREFIX, DRAINING_PREFIX, ERROR_KEY, STREAM_KEY,
-    error_status)
+    TENANT_KEY, error_status)
 from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
@@ -431,6 +431,17 @@ class HttpFrontend:
         for inputs in instances:
             if not isinstance(inputs, dict) or not inputs:
                 return 400, {"error": "inputs must be a non-empty object"}
+            # __tenant__ rides the JSON inputs next to the tensors and
+            # is lifted onto the wire blob's out-of-band key, never
+            # into the tensor dict (ISSUE-13 parameter lanes)
+            inputs = dict(inputs)
+            tenant = inputs.pop(TENANT_KEY, None)
+            if tenant is not None and not isinstance(tenant, int):
+                return 400, {"error": f"{TENANT_KEY} must be an "
+                                      "integer lane id"}
+            if not inputs:
+                return 400, {"error": "inputs must carry at least one "
+                                      "tensor besides " + TENANT_KEY}
             try:
                 tensors = {k: self._as_tensor(v)
                            for k, v in inputs.items()}
@@ -443,7 +454,7 @@ class HttpFrontend:
             uri = uuid.uuid4().hex
             self.router.register(uri)
             uris.append(uri)
-            if not self._in.enqueue(uri, **tensors):
+            if not self._in.enqueue(uri, tenant=tenant, **tensors):
                 # bounded-queue backpressure or admission-control
                 # shedding -> 503 (+ Retry-After header added by the
                 # handler); the reference surfaces Redis OOM as an

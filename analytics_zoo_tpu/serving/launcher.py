@@ -217,8 +217,18 @@ def _load_model(cfg: Dict[str, Any]) -> InferenceModel:
     return model
 
 
-def launch(config: Dict[str, Any]) -> ServingApp:
-    """Assemble and start a deployment from a parsed config dict."""
+def launch(config: Dict[str, Any], model: Any = None) -> ServingApp:
+    """Assemble and start a deployment from a parsed config dict.
+
+    ``model`` injects a pre-built model object instead of loading one
+    from ``model.path`` -- the population path (ISSUE-13): a
+    :class:`~analytics_zoo_tpu.inference.population.
+    PopulationInferenceModel` is built in-process from a trained
+    ``PopulationEstimator`` (``from_estimator``), not from a saved
+    directory, and rides the same worker / drain / supervisor /
+    frontend assembly as a loaded ``InferenceModel``. Any object
+    honoring the ``predict_async(x) -> (outputs, n)`` contract works.
+    """
     # fail fast on a bad conf file / AZT_* env var: every spec'd
     # zoo.* key's resolved value is checked against the type/range
     # metadata (common.config._SPECS) before any thread starts
@@ -246,8 +256,9 @@ def launch(config: Dict[str, Any]) -> ServingApp:
     # with every sub-key defaulted is valid), `enabled: false` opts out
     gen_enabled = ("generation" in config
                    and bool(gen_cfg.get("enabled", True)))
-    model = (None if gen_enabled and not config.get("model")
-             else _load_model(config))
+    if model is None:
+        model = (None if gen_enabled and not config.get("model")
+                 else _load_model(config))
     data = config.get("data") or {}
     params = config.get("params") or {}
     http = config.get("http") or {}
@@ -385,8 +396,8 @@ def launch(config: Dict[str, Any]) -> ServingApp:
                            worker.batcher.batch_size)
         warm = params.get("warm_batch_sizes", bucket_ladder(warm_cap))
         if warm:
-            warm_example = params.get("warm_example",
-                                      model.example_input)
+            warm_example = params.get(
+                "warm_example", getattr(model, "example_input", None))
             if warm_example is not None:
                 model.warm_up(warm_example, batch_sizes=tuple(warm))
             else:

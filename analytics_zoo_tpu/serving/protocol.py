@@ -16,6 +16,10 @@ Reserved wire keys (AZT1/npz blob tensor names; see
 - ``__trace__``     obs trace id riding the blob (zoo.obs.trace.*)
 - ``__deadline__``  absolute epoch-seconds deadline
                     (zoo.serving.deadline_ms)
+- ``__tenant__``    parameter-lane id for population-backed models
+                    (ISSUE-13): selects which member of a stacked
+                    parameter tree answers this request; one warmed
+                    compile serves every tenant (zoo.serving.tenant.*)
 - ``__error__``     reply-side: the structured error message tensor
 
 Structured error prefixes (the *class* of a failure rides the reply
@@ -59,13 +63,21 @@ ERROR_KEY = "__error__"
 STREAM_KEY = "__stream__"
 MAX_TOKENS_KEY = "__max_tokens__"
 EOS_KEY = "__eos__"
+# per-tenant parameter lanes (ISSUE-13): the lane index into a
+# population-backed model's stacked parameter tree. A request carrying
+# it dispatches through the SAME warmed executable as every other
+# tenant -- the lane is a traced argument, not a shape -- so thousands
+# of per-tenant variants serve from one compile. Absent -> the
+# zoo.serving.tenant.default_lane (or a 400 invalid_request when
+# zoo.serving.tenant.strict).
+TENANT_KEY = "__tenant__"
 
 # request-side out-of-band keys the decoder strips from tensor dicts
 # (ERROR_KEY/STREAM_KEY are reply-side only: model outputs named
 # "error" stay usable, and an error reply is recognised by ERROR_KEY's
 # presence, a stream chunk by STREAM_KEY's)
 WIRE_KEYS = (URI_KEY, REPLY_KEY, TRACE_KEY, DEADLINE_KEY,
-             MAX_TOKENS_KEY, EOS_KEY)
+             MAX_TOKENS_KEY, EOS_KEY, TENANT_KEY)
 
 # ------------------------------------------------------ error prefixes --
 DEADLINE_PREFIX = "deadline_exceeded"

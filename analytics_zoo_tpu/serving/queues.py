@@ -28,8 +28,8 @@ from analytics_zoo_tpu.obs import tracing as _tracing
 from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.obs.metrics import get_registry as _get_registry
 from analytics_zoo_tpu.serving.protocol import (
-    DEADLINE_KEY, EOS_KEY, MAX_TOKENS_KEY, REPLY_KEY, TRACE_KEY,
-    URI_KEY, WIRE_KEYS)
+    DEADLINE_KEY, EOS_KEY, MAX_TOKENS_KEY, REPLY_KEY, TENANT_KEY,
+    TRACE_KEY, URI_KEY, WIRE_KEYS)
 
 # client-side data-plane counters (the queues' entry in the unified
 # registry): offered load, backpressure rejections, drained results
@@ -64,7 +64,8 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
             trace_id: Optional[str] = None,
             deadline: Optional[float] = None,
             max_tokens: Optional[int] = None,
-            eos: Optional[int] = None) -> bytes:
+            eos: Optional[int] = None,
+            tenant: Optional[int] = None) -> bytes:
     items = [(URI_KEY, np.asarray(uri))]
     if reply_to:
         # reply-to stream for brokered deployments: the worker that
@@ -83,6 +84,10 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
     if eos is not None:
         # generation stop token id (-1 = none)
         items.append((EOS_KEY, np.asarray(int(eos), np.int32)))
+    if tenant is not None:
+        # parameter-lane id (ISSUE-13): which member of a population-
+        # backed model's stacked tree answers this request
+        items.append((TENANT_KEY, np.asarray(int(tenant), np.int32)))
     if deadline is not None:
         # absolute epoch-seconds deadline (zoo.serving.deadline_ms,
         # stamped at enqueue): the worker rejects expired requests at
@@ -204,6 +209,23 @@ def _decode_request(blob: bytes
     uri, reply, trace, deadline = _request_meta(z)
     return uri, {k: v for k, v in z.items()
                  if k not in _META_KEYS}, reply, trace, deadline
+
+
+def _decode_predict(blob: bytes
+                    ) -> Tuple[str, Dict[str, np.ndarray],
+                               Optional[str], Optional[str],
+                               Optional[float], Optional[int]]:
+    """The predict worker's decode: ``_decode_request``'s 5-tuple plus
+    the ``__tenant__`` parameter-lane id (None when the request names
+    no tenant). A separate function -- NOT a new arity for
+    ``_decode_request`` -- because that 5-tuple is unpacked outside
+    this module (resilience requeue, redis adapter, tests)."""
+    z = _decode_to_dict(blob)
+    uri, reply, trace, deadline = _request_meta(z)
+    tenant = (int(z[TENANT_KEY].reshape(()))
+              if TENANT_KEY in z else None)
+    tensors = {k: v for k, v in z.items() if k not in _META_KEYS}
+    return uri, tensors, reply, trace, deadline, tenant
 
 
 def _decode_generation(blob: bytes
@@ -624,12 +646,15 @@ class InputQueue:
     def queue(self):
         return self._q
 
-    def enqueue(self, uri: str, **tensors) -> bool:
+    def enqueue(self, uri: str, tenant: Optional[int] = None,
+                **tensors) -> bool:
         """False means the queue refused the request -- full (hard
         backpressure; the reference surfaces Redis OOM errors here,
         client.py:176-192) or shedding (depth >= ``shed_depth``). A
         trace context open on this thread (obs.tracing) rides the blob
-        as ``__trace__`` -- one thread-local read when tracing is off."""
+        as ``__trace__`` -- one thread-local read when tracing is off.
+        ``tenant`` selects a parameter lane of a population-backed
+        model (ISSUE-13; rides the blob as ``__tenant__``)."""
         if self.shed_depth and self._shed():
             return False
         deadline = (time.time() + self.deadline_ms / 1000.0
@@ -637,7 +662,7 @@ class InputQueue:
         ok = self._q.put(_encode(uri, tensors,
                                  reply_to=self.reply_stream,
                                  trace_id=_tracing.current_trace_id(),
-                                 deadline=deadline))
+                                 deadline=deadline, tenant=tenant))
         _M_ENQ.inc()
         if not ok:
             _M_ENQ_REJECTED.inc()

@@ -48,8 +48,8 @@ from analytics_zoo_tpu.obs.tracing import get_tracer
 from analytics_zoo_tpu.serving.batcher import AdaptiveBatcher, MicroBatcher
 from analytics_zoo_tpu.serving.chaos import chaos_point
 from analytics_zoo_tpu.serving.protocol import (
-    CIRCUIT_PREFIX, DEADLINE_PREFIX, ERROR_KEY)
-from analytics_zoo_tpu.serving.queues import _decode_request, _encode
+    CIRCUIT_PREFIX, DEADLINE_PREFIX, ERROR_KEY, INVALID_PREFIX)
+from analytics_zoo_tpu.serving.queues import _decode_predict, _encode
 from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
@@ -339,6 +339,12 @@ class ServingWorker:
         # reclaim. None for every other backend: one getattr at
         # construction, zero per-request cost
         self._acker = getattr(self._in, "ack_uris", None)
+        # tenant-lane routing (ISSUE-13): population-backed models
+        # expose tenant_lanes (the member count) + resolve_lane; every
+        # other model leaves it None, and a request carrying __tenant__
+        # anyway is a structured 400 -- one getattr at construction,
+        # zero per-request cost on the no-tenant path
+        self._tenant_lanes = getattr(model, "tenant_lanes", None)
         if breaker is None and bool(
                 cfg.get("zoo.serving.breaker.enabled", False)):
             from analytics_zoo_tpu.serving.resilience import (
@@ -429,15 +435,15 @@ class ServingWorker:
         """Wire-decode a pulled micro-batch, then image-decode through
         the shared thread pool. Returns (items, failures,
         decode_seconds); items are (uri, tensors, reply, trace,
-        deadline), failures are (uri, reply, message) -- undecodable
-        images plus requests already past their deadline."""
+        deadline, tenant), failures are (uri, reply, message) --
+        undecodable images plus requests already past their deadline."""
         t0 = time.perf_counter()
         with self.timer.timing("decode", batch=len(blobs)):
             items: List[Tuple[str, Dict[str, np.ndarray],
                               Optional[str], Optional[str],
-                              Optional[float]]]
+                              Optional[float], Optional[int]]]
             try:  # fast path: no per-item try frames on clean batches
-                items = [_decode_request(b) for b in blobs]
+                items = [_decode_predict(b) for b in blobs]
                 if self.ledger is not None:
                     for b, it in zip(blobs, items):
                         self.ledger.record(it[0], b)
@@ -445,7 +451,7 @@ class ServingWorker:
                 items = []
                 for b in blobs:
                     try:
-                        items.append(_decode_request(b))
+                        items.append(_decode_predict(b))
                     except Exception as e:  # malformed blob: drop,
                         logger.exception(   # keep serving
                             "serving: undecodable request dropped: %s",
@@ -507,11 +513,16 @@ class ServingWorker:
     def _group_compatible(items):
         """Group requests whose tensors share keys+shapes+dtypes so they
         stack into one device batch (ref: batchInput groups by model
-        signature implicitly -- one model, one schema)."""
+        signature implicitly -- one model, one schema). The tenant lane
+        joins the signature: a device batch answers ONE lane, so
+        same-shape requests for different tenants dispatch separately
+        (each through the same warmed executable -- the lane is traced,
+        not a shape)."""
         groups: Dict[Any, List] = {}
         for item in items:
-            sig = tuple(sorted((k, v.shape, str(v.dtype))
-                               for k, v in item[1].items()))
+            sig = (tuple(sorted((k, v.shape, str(v.dtype))
+                                for k, v in item[1].items())),
+                   item[5] if len(item) > 5 else None)
             groups.setdefault(sig, []).append(item)
         return list(groups.values())
 
@@ -535,6 +546,25 @@ class ServingWorker:
                     [(u, r, f"{CIRCUIT_PREFIX}: backend dispatch "
                             "suspended after repeated failures")
                      for u, r in zip(uris, replies)])
+        # tenant-lane resolution (ISSUE-13): grouping made the lane
+        # uniform across this group. Resolution failures (lane out of
+        # range, missing tenant under strict) are CLIENT errors -- they
+        # reply with the structured invalid_request message before any
+        # device work and never feed the breaker
+        tenant = group[0][5] if len(group[0]) > 5 else None
+        lane = None
+        if self._tenant_lanes is not None:
+            try:
+                lane = self.model.resolve_lane(tenant)
+            except ValueError as e:
+                return (_ERRORS, [(u, r, str(e))
+                                  for u, r in zip(uris, replies)])
+        elif tenant is not None:
+            return (_ERRORS,
+                    [(u, r, f"{INVALID_PREFIX}: request names tenant "
+                            f"lane {tenant} but the serving model has "
+                            "no parameter lanes")
+                     for u, r in zip(uris, replies)])
         t0 = time.perf_counter()  # this group's own prep starts here
         with self.timer.timing("stack", batch=len(group)):
             stacked = {
@@ -545,7 +575,10 @@ class ServingWorker:
         try:
             with self.timer.timing("predict_dispatch", batch=len(group)):
                 if hasattr(self.model, "predict_async"):
-                    preds, n = self.model.predict_async(x)
+                    if self._tenant_lanes is not None:
+                        preds, n = self.model.predict_async(x, lane=lane)
+                    else:
+                        preds, n = self.model.predict_async(x)
                 else:  # duck-typed models (tests): synchronous path
                     preds, n = self.model.predict(x), len(group)
         except Exception as e:  # push per-request errors, keep serving

@@ -17,6 +17,9 @@
 #                                        # plus the generation soak smoke
 #                                        # (60 overlapping token streams,
 #                                        # exact + exactly-once + A/B)
+#                                        # plus the automl vectorized A/B
+#                                        # smoke (8-trial cohort vs pool,
+#                                        # per-trial reward parity gate)
 #
 # Any other arguments are forwarded to scripts/zoolint.py.
 set -euo pipefail
@@ -42,4 +45,6 @@ if [ "$SOAK" = 1 ]; then
     python scripts/fleet_soak.py --smoke
     echo "== generation soak (smoke) =="
     python scripts/perf_generation.py --smoke
+    echo "== automl vectorized A/B (smoke) =="
+    python scripts/perf_automl.py --smoke
 fi

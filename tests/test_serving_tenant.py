@@ -1,0 +1,203 @@
+"""Per-tenant parameter-lane serving tests (ISSUE-13).
+
+The contract: a ``__tenant__`` wire key selects which member of a
+population-backed model's stacked parameter tree answers a request,
+every tenant dispatches through the SAME warmed executable (the lane
+is a traced argument, not a shape), lane errors are structured 400s,
+and ensemble mode replies with the population mean + variance.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.inference.population import PopulationInferenceModel
+from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
+from analytics_zoo_tpu.serving.protocol import ERROR_KEY, INVALID_PREFIX
+from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.worker import ServingWorker
+
+N = 4
+
+
+def make_population(mode="tenant", **kw):
+    """N members whose weights differ only by lane: member i scales
+    its input by (i+1), so replies identify the answering lane."""
+    variables = {"params": {
+        "w": np.arange(1.0, N + 1).astype(np.float32)}}
+
+    def apply_fn(v, x):
+        return x * v["params"]["w"]
+
+    return PopulationInferenceModel(apply_fn, variables, mode=mode,
+                                    **kw)
+
+
+def drain(out_q, want, timeout=10.0):
+    results = {}
+    deadline = time.monotonic() + timeout
+    while len(results) < want and time.monotonic() < deadline:
+        item = out_q.dequeue(timeout=0.5)
+        if item:
+            results[item[0]] = item[1]
+    return results
+
+
+class TestTenantLanes:
+    def test_distinct_tenants_one_warmed_executable(self):
+        """The acceptance shape: distinct __tenant__ ids answer with
+        distinct lane outputs, and the compile cache holds exactly the
+        warmed buckets afterwards -- no per-tenant compiles."""
+        pop = make_population()
+        assert pop.tenant_lanes == N
+        pop.warm_up(np.ones((1, 3), np.float32), batch_sizes=(1, 4))
+        warmed = len(pop._compiled)
+        in_q, out_q = InputQueue(), OutputQueue()
+        worker = ServingWorker(pop, in_q, out_q, batch_size=8,
+                               timeout_ms=20).start()
+        try:
+            x = np.full((3,), 2.0, np.float32)
+            for t in range(N):
+                assert in_q.enqueue(f"r{t}", tenant=t, x=x)
+            results = drain(out_q, N)
+        finally:
+            worker.stop()
+        assert len(results) == N
+        for t in range(N):
+            got = np.asarray(results[f"r{t}"]["output"]).ravel()
+            np.testing.assert_allclose(got, 2.0 * (t + 1), rtol=1e-6)
+        assert len(pop._compiled) == warmed, (
+            "serving distinct tenants grew the compile cache")
+
+    def test_default_lane_and_out_of_range(self):
+        pop = make_population()
+        in_q, out_q = InputQueue(), OutputQueue()
+        worker = ServingWorker(pop, in_q, out_q, batch_size=4,
+                               timeout_ms=20).start()
+        try:
+            x = np.full((3,), 2.0, np.float32)
+            in_q.enqueue("r_default", x=x)          # -> lane 0
+            in_q.enqueue("r_oob", tenant=99, x=x)   # -> structured 400
+            results = drain(out_q, 2)
+        finally:
+            worker.stop()
+        got = np.asarray(results["r_default"]["output"]).ravel()
+        np.testing.assert_allclose(got, 2.0, rtol=1e-6)
+        err = str(results["r_oob"][ERROR_KEY])
+        assert err.startswith(INVALID_PREFIX) and "out of range" in err
+
+    def test_strict_mode_requires_tenant(self):
+        pop = make_population(strict=True)
+        with pytest.raises(ValueError, match=INVALID_PREFIX):
+            pop.resolve_lane(None)
+        assert pop.resolve_lane(2) == 2
+
+    def test_tenant_on_plain_model_is_invalid_request(self):
+        class Plain:
+            def predict(self, x):
+                return x
+
+        in_q, out_q = InputQueue(), OutputQueue()
+        worker = ServingWorker(Plain(), in_q, out_q, batch_size=2,
+                               timeout_ms=20).start()
+        try:
+            in_q.enqueue("p0", tenant=1,
+                         x=np.ones((3,), np.float32))
+            results = drain(out_q, 1)
+        finally:
+            worker.stop()
+        err = str(results["p0"][ERROR_KEY])
+        assert err.startswith(INVALID_PREFIX)
+        assert "no parameter lanes" in err
+
+    def test_mixed_tenant_batch_groups_per_lane(self):
+        """Same-shape requests for different tenants ride one decode
+        wave but dispatch as per-lane device batches -- each answer
+        still comes from its own lane."""
+        pop = make_population()
+        in_q, out_q = InputQueue(), OutputQueue()
+        worker = ServingWorker(pop, in_q, out_q, batch_size=16,
+                               timeout_ms=50).start()
+        try:
+            x = np.full((3,), 3.0, np.float32)
+            uris = []
+            for i in range(8):
+                uri = f"m{i}"
+                uris.append((uri, i % N))
+                in_q.enqueue(uri, tenant=i % N, x=x)
+            results = drain(out_q, len(uris))
+        finally:
+            worker.stop()
+        for uri, t in uris:
+            got = np.asarray(results[uri]["output"]).ravel()
+            np.testing.assert_allclose(got, 3.0 * (t + 1), rtol=1e-6)
+
+
+class TestEnsembleMode:
+    def test_ensemble_replies_mean_and_variance(self):
+        ens = make_population(mode="ensemble")
+        assert ens.tenant_lanes is None
+        out = ens.predict(np.full((2, 3), 2.0, np.float32))
+        w = np.arange(1.0, N + 1)
+        np.testing.assert_allclose(np.asarray(out["mean"]),
+                                   2.0 * w.mean(), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["var"]),
+                                   4.0 * w.var(), rtol=1e-6)
+
+    def test_tenant_key_on_ensemble_model_is_invalid(self):
+        ens = make_population(mode="ensemble")
+        in_q, out_q = InputQueue(), OutputQueue()
+        worker = ServingWorker(ens, in_q, out_q, batch_size=2,
+                               timeout_ms=20).start()
+        try:
+            in_q.enqueue("e0", tenant=1, x=np.ones((3,), np.float32))
+            results = drain(out_q, 1)
+        finally:
+            worker.stop()
+        assert str(results["e0"][ERROR_KEY]).startswith(INVALID_PREFIX)
+
+
+class TestHttpTenant:
+    def test_json_tenant_key_routes_and_rejects(self):
+        """__tenant__ rides the JSON inputs: distinct ids answer from
+        distinct lanes over real HTTP, an out-of-range id is a 400."""
+        pop = make_population()
+        in_q, out_q = InputQueue(maxlen=64), OutputQueue()
+        worker = ServingWorker(pop, in_q, out_q, batch_size=8,
+                               timeout_ms=20).start()
+        fe = HttpFrontend(in_q, out_q, worker=worker,
+                          request_timeout=15).start()
+        try:
+            def post(payload):
+                req = urllib.request.Request(
+                    fe.address + "/predict",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=20) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            x = [2.0, 2.0, 2.0]
+            for t in (0, 3):
+                status, body = post(
+                    {"inputs": {"x": x, "__tenant__": t}})
+                assert status == 200, body
+                np.testing.assert_allclose(
+                    body["predictions"]["output"], [2.0 * (t + 1)] * 3,
+                    rtol=1e-6)
+            status, body = post(
+                {"inputs": {"x": x, "__tenant__": 99}})
+            assert status == 400 and body["error"] == INVALID_PREFIX
+            status, body = post(
+                {"inputs": {"x": x, "__tenant__": "zero"}})
+            assert status == 400
+            status, body = post({"inputs": {"__tenant__": 1}})
+            assert status == 400
+        finally:
+            fe.stop()
+            worker.stop()

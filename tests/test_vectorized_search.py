@@ -1,0 +1,175 @@
+"""Vectorized AutoML executor tests (ISSUE-13).
+
+The contract under test: ``SearchEngine(executor="vectorized")`` is an
+*execution strategy*, not a different search -- same seed means the
+same sampled configs, the same ASHA promotions, and per-trial rewards
+matching the sequential executor to float tolerance (each population
+lane replays the solo Estimator trajectory by construction).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.automl.predictor import time_sequence_trial
+from analytics_zoo_tpu.automl.search import SearchEngine
+from analytics_zoo_tpu.automl.space import Grid
+from analytics_zoo_tpu.obs.events import get_event_log
+
+
+def _series_df(n=150, seed=1):
+    rng = np.random.RandomState(seed)
+    dt = pd.date_range("2020-01-01", periods=n, freq="1h")
+    value = (np.sin(np.arange(n) * 2 * np.pi / 24)
+             + 0.1 * rng.randn(n)).astype(np.float32)
+    return pd.DataFrame({"datetime": dt, "value": value})
+
+
+def _ts_data(n=150):
+    df = _series_df(n)
+    spec = {"future_seq_len": 1, "dt_col": "datetime",
+            "target_col": ["value"], "extra_features_col": None,
+            "drop_missing": True}
+    return {"spec": spec, "train_df": df.iloc[:int(n * 0.8)],
+            "validation_df": df.iloc[int(n * 0.75):]}
+
+
+def _lstm_space(lrs, epochs):
+    """Fixed architecture + varying lr: every config lands in ONE
+    shape-compatible cohort (one stacked tree, one compile)."""
+    return {"model": "LSTM", "lstm_1_units": 8, "lstm_2_units": 8,
+            "dropout_1": 0.2, "dropout_2": 0.2, "lr": Grid(list(lrs)),
+            "batch_size": 32, "epochs": epochs,
+            "selected_features": ["hour"], "past_seq_len": 6}
+
+
+def _run(executor, space, data, **engine_kw):
+    eng = SearchEngine(executor=executor, **engine_kw)
+    eng.compile(data, time_sequence_trial, search_space=dict(space),
+                metric="mse", seed=0)
+    eng.run()
+    return eng
+
+
+def _sim_trial(config, data):
+    """Synthetic instant trial (module-level: pickles into the spawn
+    pool). Reward is the config's own ``x``."""
+    return {"reward_metric": float(config["x"])}
+
+
+# ------------------------------------------------- executor identity ----
+def test_same_seed_same_configs_across_executors():
+    data = _ts_data()
+    space = _lstm_space([1e-3, 1e-2], epochs=1)
+    engines = []
+    for ex in ("sequential", "process", "vectorized"):
+        eng = SearchEngine(executor=ex)
+        eng.compile(data, time_sequence_trial,
+                    search_space=dict(space), metric="mse", seed=0)
+        engines.append(eng)
+    assert engines[0].configs == engines[1].configs
+    assert engines[0].configs == engines[2].configs
+
+
+def test_fifo_reward_parity_vectorized_vs_sequential():
+    data = _ts_data()
+    space = _lstm_space([1e-3, 1e-2, 0.1], epochs=2)
+    seq = _run("sequential", space, data)
+    vec = _run("vectorized", space, data)
+    assert [t.config["lr"] for t in seq.trials] == \
+        [t.config["lr"] for t in vec.trials]
+    for a, b in zip(seq.trials, vec.trials):
+        assert a.error is None and b.error is None
+        assert abs(a.reward - b.reward) < 1e-6, (a.config["lr"],
+                                                 a.reward, b.reward)
+    assert (seq.get_best_trials(1)[0].config["lr"]
+            == vec.get_best_trials(1)[0].config["lr"])
+
+
+def test_asha_identical_promotions_and_rewards():
+    """Same seed -> the vectorized ASHA masks exactly the lanes the
+    sequential ASHA eliminates (rung-for-rung), and survivors' rewards
+    match -- in-place masking continuation == train-from-scratch."""
+    data = _ts_data()
+    space = _lstm_space([1e-3, 3e-3, 0.03, 0.1], epochs=4)
+    kw = dict(scheduler="asha", reduction_factor=2, grace_epochs=1)
+    seq = _run("sequential", space, data, **kw)
+    vec = _run("vectorized", space, data, **kw)
+    assert len(seq.trials) == len(vec.trials) == 4
+    for a, b in zip(seq.trials, vec.trials):
+        assert a.error is None and b.error is None
+        assert a.extras["rung"] == b.extras["rung"], a.config["lr"]
+        assert a.extras["rung_epochs"] == b.extras["rung_epochs"]
+        assert abs(a.reward - b.reward) < 1e-6, (a.config["lr"],
+                                                 a.reward, b.reward)
+    assert (seq.get_best_trials(1)[0].config["lr"]
+            == vec.get_best_trials(1)[0].config["lr"])
+
+
+def test_32_trial_cohort_is_one_population_dispatch():
+    """The headline shape: a 32-trial search is ONE cohort (one stacked
+    tree, one compiled train step), with spot-checked lanes matching
+    solo sequential runs of the same configs."""
+    data = _ts_data()
+    lrs = list(np.geomspace(3e-4, 0.3, 32).astype(float))
+    vec = _run("vectorized", _lstm_space(lrs, epochs=1), data)
+    assert len(vec.trials) == 32
+    assert all(t.error is None for t in vec.trials)
+    assert len({t.extras.get("cohort") for t in vec.trials
+                if t.extras}) == 1
+    compiles = [e for e in get_event_log().tail(type="compile")
+                if e.get("fields", {}).get("fn")
+                == "population.train_step"]
+    assert compiles, "population train step never compiled -> the " \
+                     "cohort did not run as a population"
+    # spot-check: lanes 0 / 15 / 31 reproduce solo sequential trials
+    spot = [lrs[0], lrs[15], lrs[31]]
+    seq = _run("sequential", _lstm_space(spot, epochs=1), data)
+    by_lr = {t.config["lr"]: t.reward for t in vec.trials}
+    for t in seq.trials:
+        assert abs(t.reward - by_lr[t.config["lr"]]) < 1e-6
+
+
+# ------------------------------------------------ satellite behaviors ----
+def test_unpicklable_config_is_a_trial_error_not_a_crash():
+    """A config value the spawn pool cannot pickle fails as THAT
+    trial's TrialOutput(error=...); the rest of the wave survives."""
+    eng = SearchEngine(executor="process", max_workers=2)
+    eng.compile(None, _sim_trial,
+                search_space={"x": Grid([1.0, lambda: None]),
+                              "epochs": 1},
+                metric="mse", seed=0)
+    eng.run()
+    assert len(eng.trials) == 2
+    ok = [t for t in eng.trials if t.error is None]
+    bad = [t for t in eng.trials if t.error is not None]
+    assert len(ok) == 1 and ok[0].reward == 1.0
+    assert len(bad) == 1
+    assert ("did not reach the worker" in bad[0].error
+            or "submission failed" in bad[0].error)
+
+
+def test_stopped_reason_reward_total_epochs_exhausted():
+    def search(stop, xs=(9.0, 4.0, 1.0)):
+        eng = SearchEngine(executor="sequential")
+        eng.compile(None, _sim_trial,
+                    search_space={"x": Grid(list(xs)), "epochs": 1},
+                    metric="mse", seed=0, stop=stop)
+        eng.run()
+        return eng
+
+    eng = search(None)
+    assert eng.stopped_reason == "exhausted"
+    assert len(eng.trials) == 3
+
+    eng = search({"reward": 5.0})  # mse: min-mode, 4.0 <= 5.0 trips
+    assert eng.stopped_reason == "reward"
+    assert len(eng.trials) == 2
+
+    eng = search({"total_epochs": 2})
+    assert eng.stopped_reason == "total_epochs"
+    assert len(eng.trials) == 2
+    assert eng.total_trial_epochs == 2
+    stops = get_event_log().tail(type="automl_search_stop")
+    assert stops and stops[-1]["fields"]["reason"] == "total_epochs"
+    assert stops[-1]["fields"]["total_epochs"] == 2
