@@ -101,9 +101,29 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.serving.deadline_ms": 0.0,
     # load shedding (0 = off): InputQueue.enqueue refuses new work
     # once queue depth reaches this, and the HTTP frontend turns the
-    # refusal into 503 + Retry-After instead of letting p99 explode
+    # refusal into 503 + Retry-After instead of letting p99 explode.
+    # ISSUE-15 turns the single threshold into a brownout LADDER:
+    # queue_depth is the interactive (highest-class) threshold, and
+    # batch/background admit only below batch_fraction/
+    # background_fraction of it -- lowest class sheds first, and a
+    # class is never refused while a lower one is admitted.
+    # retry_after_s stays the Retry-After FLOOR; the advertised value
+    # scales with an EWMA of the shed rate (ewma_alpha per-second
+    # smoothing) up to retry_after_max_s. gen_cost_tokens converts a
+    # generate request's max_tokens budget into admission cost
+    # (ceil(max_tokens / gen_cost_tokens) queue slots) so one long
+    # stream can't starve interactive traffic.
     "zoo.serving.shed.queue_depth": 0,
     "zoo.serving.shed.retry_after_s": 1.0,
+    "zoo.serving.shed.batch_fraction": 0.6,
+    "zoo.serving.shed.background_fraction": 0.3,
+    "zoo.serving.shed.retry_after_max_s": 30.0,
+    "zoo.serving.shed.ewma_alpha": 0.2,
+    "zoo.serving.shed.gen_cost_tokens": 16,
+    # priority classes (ISSUE-15): the admission class a request
+    # without __priority__ is treated as (interactive outranks batch
+    # outranks background)
+    "zoo.serving.priority.default_class": "interactive",
     # sharded serving (inference/sharded.py): route predict_async
     # through a device mesh. mode: off (single-chip, byte-identical to
     # the pre-mesh engine incl. compile-cache keys) | tp (params
@@ -153,6 +173,28 @@ _DEFAULTS: Dict[str, Any] = {
     "zoo.serving.fleet.autoscale.up_consecutive": 3,
     "zoo.serving.fleet.autoscale.down_consecutive": 10,
     "zoo.serving.fleet.autoscale.cooldown_s": 10.0,
+    # SLO-driven control (ISSUE-15): latency targets in ms (0 = that
+    # target off). With slo.enabled the autoscaler scales on SLO
+    # attainment -- worst observed service p99 vs p99_ms, generation
+    # time-to-first-token p99 vs ttft_ms, inter-token gap p99 vs
+    # inter_token_ms -- instead of raw backlog, and rolling_restart
+    # refuses to take a replica down while the interactive class is
+    # out of SLO
+    "zoo.serving.slo.enabled": False,
+    "zoo.serving.slo.p99_ms": 500.0,
+    "zoo.serving.slo.ttft_ms": 0.0,
+    "zoo.serving.slo.inter_token_ms": 0.0,
+    # router unhealthy-replica re-probe (ISSUE-15): capped-exponential
+    # + jittered schedule on which the controller re-probes a replica
+    # the router marked unhealthy, so a recovered replica rejoins
+    # rotation without waiting a full health sweep
+    "zoo.serving.fleet.reprobe_base_s": 0.05,
+    "zoo.serving.fleet.reprobe_max_s": 2.0,
+    # replica spawn backend (ISSUE-15): local = subprocess.Popen on
+    # this host (the historical behavior); manifest = no processes,
+    # the controller records per-replica configs and emits
+    # docker-compose / k8s YAML -- the multi-host seam
+    "zoo.serving.fleet.spawn_backend": "local",
     # generation serving (serving/generation, ISSUE-10): the decode
     # slot table size (concurrent streams per worker; ALSO the fixed
     # device batch of every decode step), the paged KV cache geometry
@@ -266,6 +308,13 @@ _SPECS: Dict[str, tuple] = {
     "zoo.serving.deadline_ms": ("float", 0, None),
     "zoo.serving.shed.queue_depth": ("int", 0, None),
     "zoo.serving.shed.retry_after_s": ("float", 0, None),
+    "zoo.serving.shed.batch_fraction": ("float", 0, 1),
+    "zoo.serving.shed.background_fraction": ("float", 0, 1),
+    "zoo.serving.shed.retry_after_max_s": ("float", 0, None),
+    "zoo.serving.shed.ewma_alpha": ("float", 0, 1),
+    "zoo.serving.shed.gen_cost_tokens": ("int", 1, None),
+    "zoo.serving.priority.default_class": ("enum", "interactive",
+                                           "batch", "background"),
     "zoo.serving.shard.mode": ("enum", "off", "tp", "dp", "auto"),
     "zoo.serving.shard.recipe": ("enum", "transformer_tp",
                                  "embedding_tp"),
@@ -291,6 +340,13 @@ _SPECS: Dict[str, tuple] = {
     "zoo.serving.fleet.autoscale.up_consecutive": ("int", 1, None),
     "zoo.serving.fleet.autoscale.down_consecutive": ("int", 1, None),
     "zoo.serving.fleet.autoscale.cooldown_s": ("float", 0, None),
+    "zoo.serving.slo.enabled": ("bool",),
+    "zoo.serving.slo.p99_ms": ("float", 0, None),
+    "zoo.serving.slo.ttft_ms": ("float", 0, None),
+    "zoo.serving.slo.inter_token_ms": ("float", 0, None),
+    "zoo.serving.fleet.reprobe_base_s": ("float", 0, None),
+    "zoo.serving.fleet.reprobe_max_s": ("float", 0, None),
+    "zoo.serving.fleet.spawn_backend": ("enum", "local", "manifest"),
     "zoo.generation.slots": ("int", 1, None),
     "zoo.generation.page_size": ("int", 1, None),
     "zoo.generation.num_pages": ("int", 0, None),

@@ -87,8 +87,9 @@ EVENT_TYPES: Dict[str, str] = {
                          "probe dispatch",
     "circuit_closed": "circuit breaker closed again after a "
                       "successful probe",
-    "request_shed": "input queue started shedding load at the "
-                    "configured depth (fields: depth, shed_depth)",
+    "request_shed": "admission control started shedding a priority "
+                    "class (one per shed episode per class; fields: "
+                    "depth, shed_depth, priority, cost)",
     "deadline_exceeded": "a request missed its deadline and was "
                          "rejected with a structured error "
                          "(fields: uri, error)",
@@ -121,7 +122,14 @@ EVENT_TYPES: Dict[str, str] = {
     "fleet_scale": "autoscaler (or scale_to) changed the replica "
                    "count (fields: direction, n_from, n_to, reason)",
     "rolling_restart": "rolling-restart progress (fields: phase, "
-                       "name)",
+                       "name; phase=slo_blocked aborts the restart)",
+    "replica_reprobe": "a targeted re-probe re-admitted an unhealthy "
+                       "replica between health sweeps (ISSUE-15; "
+                       "fields: name, outcome, failures)",
+    "slo_breach": "the fleet sample crossed a zoo.serving.slo.* "
+                  "target (edge-triggered, one per breach episode; "
+                  "fields: signals, p99_ms, ttft_p99_ms, "
+                  "inter_token_p99_ms)",
     "drain_begin": "deployment started draining: no new pulls, "
                    "in-flight work finishing (fields: deadline_ms)",
     "drain_complete": "drain finished or hit its deadline "

@@ -65,9 +65,11 @@ from analytics_zoo_tpu.common.log import get_logger
 from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.serving.chaos import chaos_point
-from analytics_zoo_tpu.serving.manager import _proc_identity
-from analytics_zoo_tpu.serving.protocol import REPLICA_PREFIX
+from analytics_zoo_tpu.serving.protocol import (
+    PRIORITY_CLASSES, REPLICA_PREFIX)
 from analytics_zoo_tpu.serving.redis_adapter import RedisFrontend
+from analytics_zoo_tpu.serving.spawn import (
+    SpawnBackend, make_spawn_backend)
 
 logger = get_logger(__name__)
 
@@ -115,6 +117,8 @@ class Replica:
         self.restarts = 0
         self.kill_reason: Optional[str] = None
         self.respawn_at = 0.0  # while state == "backoff"
+        self.reprobe_at = 0.0  # next targeted re-probe (unhealthy)
+        self.probe_failures = 0  # consecutive failed probes
 
     @property
     def pid(self) -> Optional[int]:
@@ -136,7 +140,16 @@ class Autoscaler:
     needs ``up_consecutive`` (resp. ``down_consecutive``) breaches in
     a row AND an expired cooldown -- an oscillating load that never
     holds a breach that long moves nothing (the no-flapping
-    property). Bounds clamp to ``[min_replicas, max_replicas]``."""
+    property). Bounds clamp to ``[min_replicas, max_replicas]``.
+
+    **SLO mode** (ISSUE-15): with ``zoo.serving.slo.enabled`` the
+    overload signal is SLO *attainment*, not raw backlog: a sample is
+    overloaded when any configured target (``zoo.serving.slo.p99_ms``
+    / ``ttft_ms`` / ``inter_token_ms``; 0 disables a target) is
+    breached or the highest priority class is being shed, and
+    underloaded only when every target is met with 2x headroom AND the
+    backlog is low. The streak/cooldown machinery is shared, so the
+    no-flapping property carries over verbatim."""
 
     def __init__(self, min_replicas: Optional[int] = None,
                  max_replicas: Optional[int] = None,
@@ -145,7 +158,11 @@ class Autoscaler:
                  p99_high_ms: Optional[float] = None,
                  up_consecutive: Optional[int] = None,
                  down_consecutive: Optional[int] = None,
-                 cooldown_s: Optional[float] = None, clock=None):
+                 cooldown_s: Optional[float] = None, clock=None,
+                 slo_enabled: Optional[bool] = None,
+                 slo_p99_ms: Optional[float] = None,
+                 slo_ttft_ms: Optional[float] = None,
+                 slo_inter_token_ms: Optional[float] = None):
         cfg = get_config()
 
         def _get(val, key, cast):
@@ -173,21 +190,67 @@ class Autoscaler:
         self.cooldown_s = _get(
             cooldown_s, "zoo.serving.fleet.autoscale.cooldown_s",
             float)
+        self.slo_enabled = _get(
+            slo_enabled, "zoo.serving.slo.enabled", bool)
+        self.slo_p99_ms = _get(
+            slo_p99_ms, "zoo.serving.slo.p99_ms", float)
+        self.slo_ttft_ms = _get(
+            slo_ttft_ms, "zoo.serving.slo.ttft_ms", float)
+        self.slo_inter_token_ms = _get(
+            slo_inter_token_ms, "zoo.serving.slo.inter_token_ms",
+            float)
         self._clock = clock or time.monotonic
         self._over = 0
         self._under = 0
         self._last_action = None  # monotonic stamp of the last +-1
 
+    def slo_breaches(self, p99_ms: Optional[float] = None,
+                     ttft_p99_ms: Optional[float] = None,
+                     inter_token_p99_ms: Optional[float] = None,
+                     margin: float = 1.0) -> List[str]:
+        """Names of the configured SLO targets the sample breaches
+        (``margin`` scales the targets: 0.5 asks "met with 2x
+        headroom?"). A target of 0 is not configured; a missing
+        sample (None -- no traffic of that kind) cannot breach."""
+        out = []
+        for name, target, value in (
+                ("p99_ms", self.slo_p99_ms, p99_ms),
+                ("ttft_ms", self.slo_ttft_ms, ttft_p99_ms),
+                ("inter_token_ms", self.slo_inter_token_ms,
+                 inter_token_p99_ms)):
+            if (target > 0 and value is not None
+                    and value > target * margin):
+                out.append(name)
+        return out
+
     def decide(self, n_replicas: int, backlog: int,
                shed_rate: float = 0.0,
-               p99_ms: Optional[float] = None) -> int:
+               p99_ms: Optional[float] = None,
+               ttft_p99_ms: Optional[float] = None,
+               inter_token_p99_ms: Optional[float] = None,
+               high_shed_rate: float = 0.0) -> int:
         """One sample in, one of (-1, 0, +1) out."""
-        over = (backlog > self.backlog_high or shed_rate > 0
-                or (self.p99_high_ms > 0 and p99_ms is not None
-                    and p99_ms > self.p99_high_ms))
-        under = (backlog <= self.backlog_low and shed_rate <= 0
-                 and (p99_ms is None or self.p99_high_ms <= 0
-                      or p99_ms < self.p99_high_ms / 2))
+        if self.slo_enabled:
+            # SLO attainment drives scaling: breach of any target (or
+            # shedding the highest class -- brownout already failed to
+            # protect it) is overload; underload needs every target
+            # met with 2x headroom and a drained backlog
+            over = bool(self.slo_breaches(
+                p99_ms, ttft_p99_ms, inter_token_p99_ms)
+                or high_shed_rate > 0)
+            under = (not over
+                     and not self.slo_breaches(
+                         p99_ms, ttft_p99_ms, inter_token_p99_ms,
+                         margin=0.5)
+                     and backlog <= self.backlog_low
+                     and shed_rate <= 0)
+        else:
+            over = (backlog > self.backlog_high or shed_rate > 0
+                    or (self.p99_high_ms > 0 and p99_ms is not None
+                        and p99_ms > self.p99_high_ms))
+            under = (backlog <= self.backlog_low and shed_rate <= 0
+                     and (p99_ms is None or self.p99_high_ms <= 0
+                          or p99_ms < self.p99_high_ms / 2))
         if over:
             self._over += 1
             self._under = 0
@@ -216,8 +279,15 @@ class Autoscaler:
         return 0
 
     def stats(self) -> Dict[str, Any]:
-        return {"over_streak": self._over, "under_streak": self._under,
-                "min": self.min_replicas, "max": self.max_replicas}
+        out = {"over_streak": self._over,
+               "under_streak": self._under,
+               "min": self.min_replicas, "max": self.max_replicas,
+               "slo_enabled": self.slo_enabled}
+        if self.slo_enabled:
+            out["slo"] = {"p99_ms": self.slo_p99_ms,
+                          "ttft_ms": self.slo_ttft_ms,
+                          "inter_token_ms": self.slo_inter_token_ms}
+        return out
 
 
 class FleetRouter:
@@ -510,7 +580,8 @@ class FleetController:
                  env: Optional[Dict[str, str]] = None,
                  on_result: Optional[Callable] = None,
                  poll_interval_s: Optional[float] = None,
-                 health_interval_s: Optional[float] = None):
+                 health_interval_s: Optional[float] = None,
+                 spawn_backend: Optional[SpawnBackend] = None):
         cfg = get_config()
         self.config = dict(config)
         self.n_target = int(cfg.get("zoo.serving.fleet.replicas", 2)
@@ -537,6 +608,11 @@ class FleetController:
             if autoscale is None else autoscale)
         self.autoscaler = autoscaler or (Autoscaler()
                                          if self.autoscale else None)
+        self.spawn_backend = spawn_backend or make_spawn_backend()
+        self.reprobe_base_s = float(
+            cfg.get("zoo.serving.fleet.reprobe_base_s", 0.05))
+        self.reprobe_max_s = float(
+            cfg.get("zoo.serving.fleet.reprobe_max_s", 2.0))
         self._env = dict(os.environ)
         self._env.update(env or {})
         # replicas run `python -m analytics_zoo_tpu...` from their own
@@ -559,6 +635,8 @@ class FleetController:
         self._thread: Optional[threading.Thread] = None
         self._last_health = 0.0
         self._last_shed_total = 0.0
+        self._last_high_shed_total = 0.0
+        self._slo_breached = False  # edge-detects the slo_breach event
         self.broker: Optional[RedisFrontend] = None
         self.router: Optional[FleetRouter] = None
         self.results_observed = 0
@@ -571,6 +649,10 @@ class FleetController:
     # --------------------------------------------------------- lifecycle --
     @property
     def broker_address(self) -> str:
+        if self.broker is None:
+            # not started (manifest rendering, tests): the configured
+            # endpoint, not a live socket
+            return f"{self.host}:{self._broker_port}"
         return f"{self.broker.host}:{self.broker.port}"
 
     def start(self) -> "FleetController":
@@ -637,14 +719,12 @@ class FleetController:
         except FileNotFoundError:
             pass
         rep = Replica(name, config_path, ready_file, log_path)
-        log_f = open(log_path, "ab")
-        rep.proc = subprocess.Popen(
+        rep.proc = self.spawn_backend.spawn(
+            name,
             [sys.executable, "-m", "analytics_zoo_tpu.serving.launcher",
              "-c", config_path, "--ready-file", ready_file],
-            stdout=log_f, stderr=subprocess.STDOUT,
-            start_new_session=True, env=self._env)
-        log_f.close()
-        rep.identity = _proc_identity(rep.proc.pid)
+            log_path, self._env)
+        rep.identity = self.spawn_backend.identity(rep.proc)
         rep.started_at = time.monotonic()
         with self._lock:
             self._replicas[name] = rep
@@ -659,6 +739,7 @@ class FleetController:
         while not self._stop.wait(self.poll_interval_s):
             try:
                 self._supervise_tick()
+                self._reprobe_tick()
                 now = time.monotonic()
                 if now - self._last_health >= self.health_interval_s:
                     self._last_health = now
@@ -727,6 +808,8 @@ class FleetController:
             healthy, status = self._probe(rep)
             rep.healthy = healthy
             if healthy and not was:
+                rep.probe_failures = 0
+                rep.reprobe_at = 0.0
                 emit_event("replica_healthy", "serving", name=rep.name,
                            address=rep.address)
             elif was and not healthy:
@@ -758,12 +841,58 @@ class FleetController:
     def mark_unhealthy(self, rep: Replica, why: str) -> None:
         """Router feedback: a connection-level failure outranks the
         last health poll (the poll is eventually consistent; the
-        router just witnessed the truth)."""
+        router just witnessed the truth). Schedules a targeted
+        re-probe on the capped-exponential ladder so a replica that
+        comes back is re-admitted without waiting for the next full
+        health sweep."""
         if rep.healthy:
             rep.healthy = False
             emit_event("replica_unhealthy", "serving", name=rep.name,
                        status=why[:200])
+        self._schedule_reprobe(rep)
         self._update_gauges()
+
+    def _schedule_reprobe(self, rep: Replica) -> None:
+        rep.probe_failures += 1
+        backoff = min(self.reprobe_max_s, self.reprobe_base_s
+                      * (2 ** min(rep.probe_failures - 1, 10)))
+        backoff *= 0.5 + self._rng.random() * 0.5  # de-sync jitter
+        rep.reprobe_at = time.monotonic() + backoff
+
+    def _reprobe_tick(self) -> None:
+        """Targeted recovery probes for unhealthy-but-up replicas,
+        between health sweeps: each runs on its own capped-exponential
+        schedule (base ``zoo.serving.fleet.reprobe_base_s``, cap
+        ``reprobe_max_s``, jittered), so one flapping replica neither
+        storms its own /healthz nor waits out a full sweep interval to
+        rejoin the rotation."""
+        with self._lock:
+            reps = [r for r in self._replicas.values()
+                    if r.state == "up" and not r.healthy
+                    and not r.quiesced and r.address is not None]
+        now = time.monotonic()
+        for rep in reps:
+            if now < rep.reprobe_at:
+                continue
+            healthy, status = self._probe(rep)
+            if healthy:
+                failures = rep.probe_failures
+                rep.healthy = True
+                rep.probe_failures = 0
+                rep.reprobe_at = 0.0
+                emit_event("replica_reprobe", "serving",
+                           name=rep.name, outcome="recovered",
+                           failures=failures)
+                emit_event("replica_healthy", "serving",
+                           name=rep.name, address=rep.address)
+                logger.info("replica %s recovered on re-probe",
+                            rep.name)
+            else:
+                self._schedule_reprobe(rep)
+                logger.debug("re-probe of %s still failing: %s",
+                             rep.name, status)
+        if reps:
+            self._update_gauges()
 
     # --------------------------------------------------------- routing --
     def pick_replica(self, exclude=()) -> Optional[Replica]:
@@ -824,18 +953,12 @@ class FleetController:
         self.chaos_kills += 1
         return rep.name
 
-    @staticmethod
-    def _identity_matches(rep: Replica) -> bool:
-        """STARTTIME-only /proc identity check (the manager.py rule):
-        two processes can share a recycled pid, never a
-        (pid, starttime) pair. The cmdline is deliberately excluded --
-        it legitimately changes between the fork-time snapshot and
-        exec, so comparing it would refuse to signal our own
-        freshly-spawned replica."""
-        if rep.identity is None or rep.proc is None:
-            return True  # no /proc at spawn: cannot disprove
-        now = _proc_identity(rep.proc.pid)
-        return now is None or now[0] == rep.identity[0]
+    def _identity_matches(self, rep: Replica) -> bool:
+        """Recycled-identity guard, delegated to the spawn backend
+        (the local backend runs manager.py's STARTTIME-only /proc
+        check; the manifest backend never recycles a handle)."""
+        return self.spawn_backend.identity_matches(rep.proc,
+                                                   rep.identity)
 
     def kill_replica(self, name: str, reason: str = "drill") -> bool:
         """Immediate SIGKILL -- no drain, no warning; the supervision
@@ -856,7 +979,7 @@ class FleetController:
         logger.warning("SIGKILL replica %s (pid %d, %s)", name,
                        rep.proc.pid, reason)
         try:
-            os.kill(rep.proc.pid, signal.SIGKILL)
+            self.spawn_backend.signal(rep.proc, signal.SIGKILL)
         except (ProcessLookupError, PermissionError) as e:
             logger.info("kill of %s failed: %s", name, e)
             return False
@@ -884,8 +1007,8 @@ class FleetController:
             rep.state = "stopped"
             return  # recycled pid: never signal a stranger
         try:
-            proc.send_signal(signal.SIGTERM if drain
-                             else signal.SIGKILL)
+            self.spawn_backend.signal(
+                proc, signal.SIGTERM if drain else signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             rep.state = "stopped"
             return
@@ -896,7 +1019,7 @@ class FleetController:
                            "SIGKILL", rep.name, timeout_s)
             emit_event("replica_killed", "serving", name=rep.name,
                        pid=proc.pid, reason="drain_timeout")
-            proc.kill()
+            self.spawn_backend.signal(proc, signal.SIGKILL)
             proc.wait(timeout=10.0)
         rep.healthy = False
         rep.state = "stopped"
@@ -927,14 +1050,37 @@ class FleetController:
             time.sleep(0.1)
         return False
 
-    def rolling_restart(self, timeout_s: float = 180.0) -> bool:
+    def _slo_ok(self) -> bool:
+        """The rolling-restart pacing gate: True when the high
+        priority class is within SLO (trivially True when SLO mode is
+        off). Sampled live from replica metrics -- restarting while
+        interactive traffic is already out of SLO would take the N-1
+        capacity dip out of traffic that cannot absorb it."""
+        a = self.autoscaler
+        if a is None or not a.slo_enabled:
+            return True
+        s = self._sample_replicas()
+        return not a.slo_breaches(s["p99_ms"], s["ttft_p99_ms"],
+                                  s["inter_token_p99_ms"])
+
+    def rolling_restart(self, timeout_s: float = 180.0,
+                        slo_gate: Optional[Callable[[], bool]] = None,
+                        slo_wait_s: float = 30.0) -> bool:
         """Restart every replica, one at a time, each behind a drain:
         quiesce at the router -> SIGTERM (in-process drain) -> wait
         exit -> respawn under the same consumer name -> wait healthy.
         At most one replica is ever down, so serving capacity stays
         >= N-1 throughout; ``min_healthy_during_restart`` records the
         health tick's observed floor as evidence. Returns True when
-        every replica came back healthy."""
+        every replica came back healthy.
+
+        Before taking each replica down the ``slo_gate`` must answer
+        True (default: :meth:`_slo_ok` -- the high class is within
+        SLO). A gate that stays False for ``slo_wait_s`` ABORTS the
+        restart (False return): shrinking capacity under an active
+        SLO breach only deepens the breach."""
+        if slo_gate is None:
+            slo_gate = self._slo_ok
         emit_event("rolling_restart", "serving", phase="begin",
                    name=None)
         self._rolling = True
@@ -944,6 +1090,17 @@ class FleetController:
             names = sorted(self._replicas)
         try:
             for name in names:
+                gate_deadline = time.monotonic() + slo_wait_s
+                while not slo_gate():
+                    if time.monotonic() >= gate_deadline:
+                        emit_event("rolling_restart", "serving",
+                                   phase="slo_blocked", name=name)
+                        logger.error(
+                            "rolling restart aborted before %s: the "
+                            "high priority class stayed out of SLO "
+                            "for %.1fs", name, slo_wait_s)
+                        return False
+                    time.sleep(min(0.2, self.poll_interval_s))
                 emit_event("rolling_restart", "serving",
                            phase="replica", name=name)
                 with self._lock:
@@ -1026,23 +1183,49 @@ class FleetController:
 
     def _autoscale_tick(self) -> None:
         backlog = self.broker.store.backlog(self.stream, self.group)
-        shed_total, p99_ms = self._sample_replicas()
-        shed_rate = max(0.0, shed_total - self._last_shed_total)
-        self._last_shed_total = shed_total
+        sample = self._sample_replicas()
+        shed_rate = max(0.0, sample["shed_total"]
+                        - self._last_shed_total)
+        high_shed_rate = max(0.0, sample["high_shed_total"]
+                             - self._last_high_shed_total)
+        self._last_shed_total = sample["shed_total"]
+        self._last_high_shed_total = sample["high_shed_total"]
         states = self.replica_states()
+        if self.autoscaler.slo_enabled:
+            breaches = self.autoscaler.slo_breaches(
+                sample["p99_ms"], sample["ttft_p99_ms"],
+                sample["inter_token_p99_ms"])
+            if breaches and not self._slo_breached:
+                # edge-triggered: one event per breach episode
+                emit_event("slo_breach", "serving",
+                           signals=",".join(breaches),
+                           p99_ms=sample["p99_ms"],
+                           ttft_p99_ms=sample["ttft_p99_ms"],
+                           inter_token_p99_ms=sample[
+                               "inter_token_p99_ms"])
+            self._slo_breached = bool(breaches)
         decision = self.autoscaler.decide(
             states["total"], backlog, shed_rate=shed_rate,
-            p99_ms=p99_ms)
+            p99_ms=sample["p99_ms"],
+            ttft_p99_ms=sample["ttft_p99_ms"],
+            inter_token_p99_ms=sample["inter_token_p99_ms"],
+            high_shed_rate=high_shed_rate)
         if decision:
             self.scale_to(states["total"] + decision,
                           reason="autoscale")
 
-    def _sample_replicas(self):
-        """(shed_total, worst p99 ms) scraped from replica
+    def _sample_replicas(self) -> Dict[str, Any]:
+        """Fleet-wide load/SLO sample scraped from replica
         /metrics.json endpoints -- best-effort: an unreachable replica
-        contributes nothing (its health probe is the loud signal)."""
-        shed_total = 0.0
-        p99_ms: Optional[float] = None
+        contributes nothing (its health probe is the loud signal).
+        Returns shed totals (all classes + the highest class alone)
+        and the worst-replica p99 / TTFT-p99 / inter-token-p99 in
+        milliseconds (None = no such traffic anywhere)."""
+        out: Dict[str, Any] = {
+            "shed_total": 0.0, "high_shed_total": 0.0,
+            "p99_ms": None, "ttft_p99_ms": None,
+            "inter_token_p99_ms": None}
+        high_label = f"class={PRIORITY_CLASSES[0]}"
         with self._lock:
             reps = [r for r in self._replicas.values()
                     if r.address and r.state == "up"]
@@ -1061,16 +1244,29 @@ class FleetController:
             shed = reg.get("zoo_serving_shed_total")
             if isinstance(shed, dict):
                 # snapshot family shape: {"type", "help",
-                # "values": {label-key: value}}
-                for v in (shed.get("values") or {}).values():
-                    shed_total += float(v or 0.0)
+                # "values": {"<label>=<value>": count}}
+                for key, v in (shed.get("values") or {}).items():
+                    out["shed_total"] += float(v or 0.0)
+                    if key == high_label or key == "":
+                        # unlabeled = pre-ladder replica: conservative
+                        # reading says the high class was refused
+                        out["high_shed_total"] += float(v or 0.0)
             service = (snap.get("worker", {}).get("stages", {})
                        .get("service", {}))
             p99 = service.get("p99_s")  # Timer.summary's "_s" suffix
             if p99 is not None:
-                p99 = float(p99) * 1000.0
-                p99_ms = p99 if p99_ms is None else max(p99_ms, p99)
-        return shed_total, p99_ms
+                ms = float(p99) * 1000.0
+                out["p99_ms"] = (ms if out["p99_ms"] is None
+                                 else max(out["p99_ms"], ms))
+            gen_lat = snap.get("generation", {}).get("latency", {})
+            for stage, key in (("ttft", "ttft_p99_ms"),
+                               ("inter_token", "inter_token_p99_ms")):
+                p = (gen_lat.get(stage) or {}).get("p99_s")
+                if p is not None:
+                    ms = float(p) * 1000.0
+                    out[key] = (ms if out[key] is None
+                                else max(out[key], ms))
+        return out
 
     # ----------------------------------------------------------- stats --
     def stats(self) -> Dict[str, Any]:

@@ -53,8 +53,9 @@ from analytics_zoo_tpu.serving.generation.batcher import (
     ContinuousBatcher)
 from analytics_zoo_tpu.serving.protocol import (
     DEADLINE_PREFIX, ERROR_KEY, GENERATION_PREFIX, INVALID_PREFIX,
-    STREAM_KEY)
+    STREAM_KEY, priority_index, priority_name)
 from analytics_zoo_tpu.serving.queues import _decode_generation, _encode
+from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
 
@@ -84,26 +85,36 @@ _M_OVERFLOW = _REG.counter(
     "zoo_generation_overflow_total",
     "Generate requests refused at admission because the paged KV "
     "cache had no free slot/pages (503 + Retry-After at the frontend)")
+_M_LATENCY = _REG.histogram(
+    "zoo_generation_latency_seconds",
+    "Generation latency stages: ttft = admission to first token, "
+    "inter_token = gap between consecutive tokens of one stream "
+    "(the SLO autoscaler's zoo.serving.slo.ttft_ms / inter_token_ms "
+    "inputs)",
+    labelnames=("stage",))
 
 
 class _GenStream:
     """Host-side state of one live stream (one engine slot)."""
 
     __slots__ = ("uri", "reply", "trace", "deadline", "eos",
-                 "max_tokens", "produced", "pending", "seq",
-                 "admitted_at")
+                 "max_tokens", "priority", "produced", "pending",
+                 "seq", "admitted_at", "last_token_at")
 
-    def __init__(self, uri, reply, trace, deadline, eos, max_tokens):
+    def __init__(self, uri, reply, trace, deadline, eos, max_tokens,
+                 priority=None):
         self.uri = uri
         self.reply = reply
         self.trace = trace
         self.deadline = deadline
         self.eos = eos
         self.max_tokens = max_tokens
+        self.priority = priority
         self.produced = 0      # tokens generated so far
         self.pending: List[int] = []  # generated, not yet chunked
         self.seq = 0           # next chunk sequence number
         self.admitted_at = time.monotonic()
+        self.last_token_at: Optional[float] = None
 
 
 class GenerationWorker:
@@ -144,6 +155,13 @@ class GenerationWorker:
         self._streams: Dict[int, _GenStream] = {}
         self._reply_queues: Dict[str, Any] = {}
         self.served = 0
+        # SLO surfaces (ISSUE-15): TTFT and inter-token samples feed
+        # the fleet's SLO-driven autoscaler via metrics()["latency"]
+        self._lat = Timer(keep_samples=4096, mirror=_M_LATENCY)
+        self._default_priority = priority_index(
+            cfg.get("zoo.serving.priority.default_class",
+                    "interactive")) or 0
+        self._class_served: Dict[str, int] = {}
         # supervision / fleet seams (the ServingWorker contract): the
         # Supervisor reads heartbeat/_thread/_stop/_drain and clears
         # _inflight on restart; consumer-group backends expose
@@ -225,7 +243,7 @@ class GenerationWorker:
         chaos_point("decode")
         try:
             (uri, tensors, reply, trace, deadline, max_toks,
-             eos) = _decode_generation(blob)
+             eos, priority) = _decode_generation(blob)
         except Exception as e:
             logger.exception(
                 "generation: undecodable request dropped: %s", e)
@@ -291,9 +309,14 @@ class GenerationWorker:
                 get_tracer().add_span("gen_prefill", trace, t0,
                                       time.perf_counter())
             get_inflight().add((uri,))
-            stream = _GenStream(uri, reply, trace, deadline, eos,
-                                max_toks)
+            stream = _GenStream(
+                uri, reply, trace, deadline, eos, max_toks,
+                priority=(self._default_priority
+                          if priority is None else priority))
             self._streams[slot] = stream
+            cls = priority_name(stream.priority)
+            self._class_served[cls] = (
+                self._class_served.get(cls, 0) + 1)
         except BaseException:
             # nothing owns the slot until the stream table does: a
             # raise in this window (tracer, crash manifest, stream
@@ -332,6 +355,12 @@ class GenerationWorker:
                       tok: int) -> int:
         """Append one generated token; flush/terminate as policy
         dictates. Returns 1 when this token finished the stream."""
+        now = time.monotonic()
+        if stream.produced == 0:
+            self._lat.record("ttft", now - stream.admitted_at)
+        elif stream.last_token_at is not None:
+            self._lat.record("inter_token", now - stream.last_token_at)
+        stream.last_token_at = now
         stream.pending.append(int(tok))
         stream.produced += 1
         _M_TOKENS.inc()
@@ -513,6 +542,10 @@ class GenerationWorker:
             "defaults": {"max_tokens": self.default_max_tokens,
                          "eos": self.default_eos,
                          "chunk_tokens": self.stream_chunk_tokens},
+            # latency.ttft / latency.inter_token summaries (p99_s
+            # etc.) -- the fleet's SLO sampler scrapes these
+            "latency": self._lat.summary(),
+            "class_served": dict(self._class_served),
         }
         try:
             out["queue_depth"] = len(self._in)

@@ -46,8 +46,9 @@ from analytics_zoo_tpu.obs.events import get_event_log, to_jsonable
 from analytics_zoo_tpu.obs.flight import get_inflight
 from analytics_zoo_tpu.obs.metrics import get_registry
 from analytics_zoo_tpu.serving.protocol import (
-    DEADLINE_PREFIX, DRAINING_PREFIX, ERROR_KEY, STREAM_KEY,
-    TENANT_KEY, error_status)
+    DEADLINE_PREFIX, DRAINING_PREFIX, ERROR_KEY, PRIORITY_CLASSES,
+    PRIORITY_KEY, SHED_PREFIX, STREAM_KEY, TENANT_KEY, error_status,
+    priority_index)
 from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
@@ -298,7 +299,8 @@ class HttpFrontend:
                     frontend.handle_generate(self, req)
                     return
                 with frontend.timer.timing("predict_request"):
-                    code, payload = frontend.handle_predict(req)
+                    code, payload = frontend.handle_predict(
+                        req, priority=self.headers.get("X-Priority"))
                 self._reply(code, payload,
                             headers=frontend._retry_headers(code))
 
@@ -371,20 +373,22 @@ class HttpFrontend:
         self._server_thread: Optional[threading.Thread] = None
 
     # --------------------------------------------------------- requests --
-    def handle_predict(self, req: Any):
+    def handle_predict(self, req: Any, priority=None):
         """Predict with optional end-to-end tracing: when
         ``zoo.obs.trace.enabled``, the whole request runs under a fresh
         trace id (enqueued blobs carry it to the worker stages), an
         ``http_request`` span is recorded, and the response echoes the
-        id for client-side correlation."""
+        id for client-side correlation. ``priority`` is the request's
+        admission class (the ``X-Priority`` header; a per-input
+        ``__priority__`` JSON key overrides it)."""
         with tracing.maybe_trace("http_request") as trace_id:
-            code, payload = self._handle_predict(req)
+            code, payload = self._handle_predict(req, priority)
             if trace_id is not None and isinstance(payload, dict):
                 payload = dict(payload)
                 payload["trace_id"] = trace_id
             return code, payload
 
-    def _handle_predict(self, req: Any):
+    def _handle_predict(self, req: Any, priority=None):
         if self._draining:
             # structured refusal, same vocabulary as the wire errors:
             # the caller (fleet router, or a well-behaved client) sees
@@ -393,6 +397,10 @@ class HttpFrontend:
                          "detail": f"{DRAINING_PREFIX}: deployment "
                                    "is draining for restart",
                          "retry_after_s": self.retry_after_s}
+        if priority is not None and priority_index(priority) is None:
+            return 400, {"error": "unknown priority class "
+                                  f"{priority!r}; expected one of "
+                                  + ", ".join(PRIORITY_CLASSES)}
         if not isinstance(req, dict):
             return 400, {"error": "body must be a JSON object"}
         if "instances" in req:
@@ -411,7 +419,8 @@ class HttpFrontend:
         deadline = time.monotonic() + self.request_timeout
         uris: list = []
         try:
-            code, payload = self._enqueue_many(instances, uris)
+            code, payload = self._enqueue_many(instances, uris,
+                                               priority)
             if code != 200:
                 return code, payload
             preds = []
@@ -427,18 +436,24 @@ class HttpFrontend:
                 if uri is not None:
                     self.router.unregister(uri)
 
-    def _enqueue_many(self, instances, uris: list):
+    def _enqueue_many(self, instances, uris: list, priority=None):
         for inputs in instances:
             if not isinstance(inputs, dict) or not inputs:
                 return 400, {"error": "inputs must be a non-empty object"}
-            # __tenant__ rides the JSON inputs next to the tensors and
-            # is lifted onto the wire blob's out-of-band key, never
-            # into the tensor dict (ISSUE-13 parameter lanes)
+            # __tenant__ / __priority__ ride the JSON inputs next to
+            # the tensors and are lifted onto the wire blob's
+            # out-of-band keys, never into the tensor dict (ISSUE-13
+            # parameter lanes, ISSUE-15 admission classes)
             inputs = dict(inputs)
             tenant = inputs.pop(TENANT_KEY, None)
             if tenant is not None and not isinstance(tenant, int):
                 return 400, {"error": f"{TENANT_KEY} must be an "
                                       "integer lane id"}
+            pri = inputs.pop(PRIORITY_KEY, priority)
+            if pri is not None and priority_index(pri) is None:
+                return 400, {"error": f"{PRIORITY_KEY} must name a "
+                                      "priority class: "
+                                      + ", ".join(PRIORITY_CLASSES)}
             if not inputs:
                 return 400, {"error": "inputs must carry at least one "
                                       "tensor besides " + TENANT_KEY}
@@ -454,15 +469,18 @@ class HttpFrontend:
             uri = uuid.uuid4().hex
             self.router.register(uri)
             uris.append(uri)
-            if not self._in.enqueue(uri, tenant=tenant, **tensors):
+            if not self._in.enqueue(uri, tenant=tenant, priority=pri,
+                                    **tensors):
                 # bounded-queue backpressure or admission-control
                 # shedding -> 503 (+ Retry-After header added by the
                 # handler); the reference surfaces Redis OOM as an
                 # error (FrontEndApp/client.py), we tell the client
-                # when to come back instead
-                return 503, {"error": "overloaded: input queue "
-                                      "refused the request",
-                             "retry_after_s": self.retry_after_s}
+                # when to come back instead -- with a backoff that
+                # scales with current shed pressure
+                return 503, {"error": SHED_PREFIX,
+                             "detail": f"{SHED_PREFIX}: input queue "
+                                       "refused the request",
+                             "retry_after_s": self._retry_after_s()}
         return 200, None
 
     @staticmethod
@@ -498,12 +516,27 @@ class HttpFrontend:
             return 500, {"error": msg}
         return 200, _to_jsonable(result)
 
+    def _retry_after_s(self, queue=None) -> float:
+        """The backoff to advertise on a shed 503: the refusing
+        queue's adaptive value (EWMA shed pressure, ISSUE-15) when it
+        exposes one, never below the configured floor."""
+        q = self._in if queue is None else queue
+        fn = getattr(q, "retry_after_s", None)
+        if callable(fn):
+            try:
+                return max(self.retry_after_s, float(fn()))
+            except (TypeError, ValueError):
+                pass
+        return self.retry_after_s
+
     def _retry_headers(self, code: int) -> Optional[Dict[str, str]]:
         """Every 503 carries Retry-After (the load-shed / drain /
-        overflow backoff contract shared by /predict and /generate)."""
+        overflow backoff contract shared by /predict and /generate).
+        The advertised seconds track shed pressure: the configured
+        retry_after_s is the floor, consecutive sheds raise it."""
         if code != 503:
             return None
-        return {"Retry-After": str(max(1, int(self.retry_after_s)))}
+        return {"Retry-After": str(max(1, int(self._retry_after_s())))}
 
     # ------------------------------------------------------ generation --
     def handle_generate(self, handler, req: Any) -> None:
@@ -516,8 +549,11 @@ class HttpFrontend:
         stream: expiry mid-stream produces a structured
         ``deadline_exceeded`` terminal event, never a silent close."""
         with tracing.maybe_trace("http_generate") as trace_id:
+            hdrs = getattr(handler, "headers", None)
             code, err, uri, stream_q, streaming = \
-                self._generate_setup(req)
+                self._generate_setup(
+                    req, priority=(hdrs.get("X-Priority")
+                                   if hdrs is not None else None))
             if uri is None:
                 handler._reply(code, err,
                                headers=self._retry_headers(code))
@@ -534,9 +570,10 @@ class HttpFrontend:
             finally:
                 self.router.unregister_stream(uri)
 
-    def _generate_setup(self, req: Any):
+    def _generate_setup(self, req: Any, priority=None):
         """Validate + enqueue; returns (code, error_payload, uri,
-        stream_queue, streaming) with uri None on refusal."""
+        stream_queue, streaming) with uri None on refusal. A
+        ``priority`` body field overrides the X-Priority header."""
         if self._gen_in is None:
             return 404, {"error": "generation serving is not enabled "
                                   "on this deployment"}, None, None, \
@@ -569,16 +606,23 @@ class HttpFrontend:
             # of billing a prefill for a token nobody asked for
             return 400, {"error": "'max_tokens' must be >= 1"}, \
                 None, None, False
+        pri = req.get("priority", priority)
+        if pri is not None and priority_index(pri) is None:
+            return 400, {"error": "'priority' must name a class: "
+                                  + ", ".join(PRIORITY_CLASSES)}, \
+                None, None, False
         streaming = bool(req.get("stream", True))
         uri = uuid.uuid4().hex
         stream_q = self.router.register_stream(uri)
         if not self._gen_in.enqueue_generation(
                 uri, np.asarray(prompt, np.int32),
-                max_tokens=max_tokens, eos=eos):
+                max_tokens=max_tokens, eos=eos, priority=pri):
             self.router.unregister_stream(uri)
-            return 503, {"error": "overloaded: generation queue "
-                                  "refused the request",
-                         "retry_after_s": self.retry_after_s}, \
+            return 503, {"error": SHED_PREFIX,
+                         "detail": f"{SHED_PREFIX}: generation queue "
+                                   "refused the request",
+                         "retry_after_s":
+                             self._retry_after_s(self._gen_in)}, \
                 None, None, False
         return 200, None, uri, stream_q, streaming
 

@@ -20,6 +20,9 @@ Reserved wire keys (AZT1/npz blob tensor names; see
                     (ISSUE-13): selects which member of a stacked
                     parameter tree answers this request; one warmed
                     compile serves every tenant (zoo.serving.tenant.*)
+- ``__priority__``  admission class index (ISSUE-15): brownout
+                    shedding refuses low classes first
+                    (zoo.serving.priority.*, zoo.serving.shed.*)
 - ``__error__``     reply-side: the structured error message tensor
 
 Structured error prefixes (the *class* of a failure rides the reply
@@ -34,6 +37,8 @@ can map it to an HTTP status without a second wire field):
   transient, retryable)
 - ``invalid_request`` -> 400 (malformed client content the worker,
   not the frontend, detected)
+- ``overloaded`` -> 503 (priority-ordered admission refusal; the
+  Retry-After adapts to shed pressure)
 
 ``ERROR_PREFIXES`` is the complete prefix -> HTTP-status contract;
 zoolint's ``error-prefix-unmapped`` rule fails any declared prefix
@@ -71,13 +76,59 @@ EOS_KEY = "__eos__"
 # zoo.serving.tenant.default_lane (or a 400 invalid_request when
 # zoo.serving.tenant.strict).
 TENANT_KEY = "__tenant__"
+# priority classes (ISSUE-15): the request's admission class rides the
+# blob as a small int32 index into PRIORITY_CLASSES, so brownout
+# shedding can refuse low classes first and a requeued/restarted
+# request keeps its class exactly like __tenant__ keeps its lane.
+# Absent -> zoo.serving.priority.default_class.
+PRIORITY_KEY = "__priority__"
 
 # request-side out-of-band keys the decoder strips from tensor dicts
 # (ERROR_KEY/STREAM_KEY are reply-side only: model outputs named
 # "error" stay usable, and an error reply is recognised by ERROR_KEY's
 # presence, a stream chunk by STREAM_KEY's)
 WIRE_KEYS = (URI_KEY, REPLY_KEY, TRACE_KEY, DEADLINE_KEY,
-             MAX_TOKENS_KEY, EOS_KEY, TENANT_KEY)
+             MAX_TOKENS_KEY, EOS_KEY, TENANT_KEY, PRIORITY_KEY)
+
+# ---------------------------------------------------- priority classes --
+# Index 0 is the HIGHEST class: the admission ladder sheds from the
+# tail of this tuple first, and the no-inversion contract is "a class
+# is never refused while a strictly lower class is admitted at the
+# same queue depth". Wire value = index (int32), so class ordering is
+# total and comparison is integer comparison.
+PRIORITY_CLASSES = ("interactive", "batch", "background")
+PRIORITY_DEFAULT = PRIORITY_CLASSES[0]
+
+
+def priority_index(value) -> Optional[int]:
+    """Normalize a class name or index to an index into
+    PRIORITY_CLASSES, or None when the value names no class."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        name = value.strip().lower()
+        if name in PRIORITY_CLASSES:
+            return PRIORITY_CLASSES.index(name)
+        return None
+    try:
+        idx = int(value)
+    except (TypeError, ValueError):
+        return None
+    if 0 <= idx < len(PRIORITY_CLASSES):
+        return idx
+    return None
+
+
+def priority_name(index) -> str:
+    """Class name for a wire index; out-of-range indexes clamp to the
+    lowest class (a garbled byte must never PROMOTE a request)."""
+    try:
+        idx = int(index)
+    except (TypeError, ValueError):
+        return PRIORITY_CLASSES[-1]
+    if 0 <= idx < len(PRIORITY_CLASSES):
+        return PRIORITY_CLASSES[idx]
+    return PRIORITY_CLASSES[-1]
 
 # ------------------------------------------------------ error prefixes --
 DEADLINE_PREFIX = "deadline_exceeded"
@@ -99,6 +150,11 @@ GENERATION_PREFIX = "generation_overflow"
 # token ids, missing prompt tensor): 400, not 500 -- bad input must
 # never read as a server fault on the error-rate dashboard
 INVALID_PREFIX = "invalid_request"
+# brownout shedding (ISSUE-15): the admission controller refused the
+# request because its class's depth threshold was exceeded --
+# transient by construction, so 503 with an ADAPTIVE Retry-After
+# (EWMA of shed pressure, zoo.serving.shed.retry_after_s the floor)
+SHED_PREFIX = "overloaded"
 
 # prefix -> HTTP status the frontend answers with; prefixes absent
 # here fall through to 500 (generic server fault), which is exactly
@@ -110,6 +166,7 @@ ERROR_PREFIXES = {
     REPLICA_PREFIX: 503,
     GENERATION_PREFIX: 503,
     INVALID_PREFIX: 400,
+    SHED_PREFIX: 503,
 }
 
 

@@ -25,14 +25,16 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.obs import tracing as _tracing
-from analytics_zoo_tpu.obs.events import emit as emit_event
 from analytics_zoo_tpu.obs.metrics import get_registry as _get_registry
+from analytics_zoo_tpu.serving.admission import AdmissionController
 from analytics_zoo_tpu.serving.protocol import (
-    DEADLINE_KEY, EOS_KEY, MAX_TOKENS_KEY, REPLY_KEY, TENANT_KEY,
-    TRACE_KEY, URI_KEY, WIRE_KEYS)
+    DEADLINE_KEY, EOS_KEY, MAX_TOKENS_KEY, PRIORITY_KEY, REPLY_KEY,
+    TENANT_KEY, TRACE_KEY, URI_KEY, WIRE_KEYS, priority_index)
 
 # client-side data-plane counters (the queues' entry in the unified
-# registry): offered load, backpressure rejections, drained results
+# registry): offered load, backpressure rejections, drained results.
+# The shed family (zoo_serving_shed_total) moved to admission.py when
+# it grew the per-class label (ISSUE-15).
 _REG = _get_registry()
 _M_ENQ = _REG.counter(
     "zoo_serving_enqueue_total",
@@ -43,10 +45,6 @@ _M_ENQ_REJECTED = _REG.counter(
 _M_DEQ = _REG.counter(
     "zoo_serving_dequeue_total",
     "Results drained from the serving output queue")
-_M_SHED = _REG.counter(
-    "zoo_serving_shed_total",
-    "Requests refused by admission-control load shedding "
-    "(zoo.serving.shed.queue_depth)")
 
 # Wire format. v1 was np.savez (one zip archive per request): simple,
 # but the zip machinery costs ~260 us per request round-trip -- it was
@@ -65,7 +63,8 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
             deadline: Optional[float] = None,
             max_tokens: Optional[int] = None,
             eos: Optional[int] = None,
-            tenant: Optional[int] = None) -> bytes:
+            tenant: Optional[int] = None,
+            priority: Optional[int] = None) -> bytes:
     items = [(URI_KEY, np.asarray(uri))]
     if reply_to:
         # reply-to stream for brokered deployments: the worker that
@@ -88,6 +87,13 @@ def _encode(uri: str, payload: Dict[str, np.ndarray],
         # parameter-lane id (ISSUE-13): which member of a population-
         # backed model's stacked tree answers this request
         items.append((TENANT_KEY, np.asarray(int(tenant), np.int32)))
+    if priority is not None:
+        # admission class index (ISSUE-15): rides the blob so a
+        # requeued/restarted request keeps its brownout class exactly
+        # like __tenant__ keeps its lane; absent -> the
+        # zoo.serving.priority.default_class at the decoder
+        items.append((PRIORITY_KEY,
+                      np.asarray(int(priority), np.int32)))
     if deadline is not None:
         # absolute epoch-seconds deadline (zoo.serving.deadline_ms,
         # stamped at enqueue): the worker rejects expired requests at
@@ -214,35 +220,43 @@ def _decode_request(blob: bytes
 def _decode_predict(blob: bytes
                     ) -> Tuple[str, Dict[str, np.ndarray],
                                Optional[str], Optional[str],
-                               Optional[float], Optional[int]]:
+                               Optional[float], Optional[int],
+                               Optional[int]]:
     """The predict worker's decode: ``_decode_request``'s 5-tuple plus
-    the ``__tenant__`` parameter-lane id (None when the request names
-    no tenant). A separate function -- NOT a new arity for
-    ``_decode_request`` -- because that 5-tuple is unpacked outside
-    this module (resilience requeue, redis adapter, tests)."""
+    the ``__tenant__`` parameter-lane id and the ``__priority__``
+    admission class (None when the request names neither). A separate
+    function -- NOT a new arity for ``_decode_request`` -- because
+    that 5-tuple is unpacked outside this module (resilience requeue,
+    redis adapter, tests)."""
     z = _decode_to_dict(blob)
     uri, reply, trace, deadline = _request_meta(z)
     tenant = (int(z[TENANT_KEY].reshape(()))
               if TENANT_KEY in z else None)
+    priority = (int(z[PRIORITY_KEY].reshape(()))
+                if PRIORITY_KEY in z else None)
     tensors = {k: v for k, v in z.items() if k not in _META_KEYS}
-    return uri, tensors, reply, trace, deadline, tenant
+    return uri, tensors, reply, trace, deadline, tenant, priority
 
 
 def _decode_generation(blob: bytes
                        ) -> Tuple[str, Dict[str, np.ndarray],
                                   Optional[str], Optional[str],
                                   Optional[float], Optional[int],
-                                  Optional[int]]:
+                                  Optional[int], Optional[int]]:
     """The generation worker's decode: ``_decode_request``'s 5-tuple
-    plus ``(max_tokens, eos)`` (None when the request omitted them --
-    the worker falls back to the ``zoo.generation.*`` defaults)."""
+    plus ``(max_tokens, eos, priority)`` (None when the request
+    omitted them -- the worker falls back to the ``zoo.generation.*``
+    / ``zoo.serving.priority.*`` defaults)."""
     z = _decode_to_dict(blob)
     uri, reply, trace, deadline = _request_meta(z)
     max_tokens = (int(z[MAX_TOKENS_KEY].reshape(()))
                   if MAX_TOKENS_KEY in z else None)
     eos = int(z[EOS_KEY].reshape(())) if EOS_KEY in z else None
+    priority = (int(z[PRIORITY_KEY].reshape(()))
+                if PRIORITY_KEY in z else None)
     tensors = {k: v for k, v in z.items() if k not in _META_KEYS}
-    return uri, tensors, reply, trace, deadline, max_tokens, eos
+    return (uri, tensors, reply, trace, deadline, max_tokens, eos,
+            priority)
 
 
 class MemQueue:
@@ -640,35 +654,56 @@ class InputQueue:
         self.deadline_ms = float(
             cfg.get("zoo.serving.deadline_ms", 0.0)
             if deadline_ms is None else deadline_ms)
-        self._shedding = False
+        # brownout ladder (ISSUE-15): the controller owns per-class
+        # thresholds, shed counters/events, and the adaptive
+        # Retry-After; requests without an explicit class admit as
+        # zoo.serving.priority.default_class
+        self._admission = AdmissionController(self.shed_depth)
+        self.default_priority = priority_index(
+            cfg.get("zoo.serving.priority.default_class",
+                    "interactive")) or 0
+        # generation admission cost: one queue slot per this many
+        # budgeted tokens (long streams are charged like the long
+        # occupancy they are)
+        self._gen_cost_tokens = int(
+            cfg.get("zoo.serving.shed.gen_cost_tokens", 16))
+        self._gen_default_tokens = int(
+            cfg.get("zoo.generation.max_tokens", 64))
 
     @property
     def queue(self):
         return self._q
 
     def enqueue(self, uri: str, tenant: Optional[int] = None,
-                **tensors) -> bool:
+                priority=None, **tensors) -> bool:
         """False means the queue refused the request -- full (hard
         backpressure; the reference surfaces Redis OOM errors here,
-        client.py:176-192) or shedding (depth >= ``shed_depth``). A
-        trace context open on this thread (obs.tracing) rides the blob
-        as ``__trace__`` -- one thread-local read when tracing is off.
+        client.py:176-192) or shedding (the brownout ladder refused
+        this request's class at the observed depth). A trace context
+        open on this thread (obs.tracing) rides the blob as
+        ``__trace__`` -- one thread-local read when tracing is off.
         ``tenant`` selects a parameter lane of a population-backed
-        model (ISSUE-13; rides the blob as ``__tenant__``)."""
-        if self.shed_depth and self._shed():
+        model (ISSUE-13; rides the blob as ``__tenant__``);
+        ``priority`` is a class name or index (ISSUE-15; rides the
+        blob as ``__priority__``, absent when the caller names none).
+        """
+        pri = priority_index(priority)
+        if self.shed_depth and self._shed(
+                self.default_priority if pri is None else pri):
             return False
         deadline = (time.time() + self.deadline_ms / 1000.0
                     if self.deadline_ms else None)
         ok = self._q.put(_encode(uri, tensors,
                                  reply_to=self.reply_stream,
                                  trace_id=_tracing.current_trace_id(),
-                                 deadline=deadline, tenant=tenant))
+                                 deadline=deadline, tenant=tenant,
+                                 priority=pri))
         _M_ENQ.inc()
         if not ok:
             _M_ENQ_REJECTED.inc()
         return ok
 
-    def _shed(self) -> bool:
+    def _shed(self, priority: int, cost: int = 1) -> bool:
         """Shed-or-admit; the depth probe costs one len() per enqueue
         (a broker RPC on TcpQueue backends), which is why shedding is
         opt-in via ``zoo.serving.shed.queue_depth``."""
@@ -676,29 +711,39 @@ class InputQueue:
             depth = len(self._q)
         except (TypeError, OSError):
             return False  # depth-less backend: cannot shed on depth
-        if depth < self.shed_depth:
-            self._shedding = False
+        if self._admission.admit(depth, priority, cost=cost):
             return False
-        _M_ENQ.inc()
-        _M_SHED.inc()
-        if not self._shedding:
-            # one event per shed EPISODE, not per refused request --
-            # under a real overload the per-request rate would churn
-            # the whole event ring with copies of the same fact
-            self._shedding = True
-            emit_event("request_shed", "serving", depth=depth,
-                       shed_depth=self.shed_depth)
+        _M_ENQ.inc()  # a shed request still counts as offered load
         return True
+
+    def retry_after_s(self) -> float:
+        """The adaptive Retry-After the frontend should advertise on
+        shed 503s (floor = zoo.serving.shed.retry_after_s, scaled by
+        current shed pressure up to retry_after_max_s)."""
+        return self._admission.retry_after_s()
+
+    @property
+    def admission(self) -> AdmissionController:
+        return self._admission
 
     def enqueue_generation(self, uri: str, tokens,
                            max_tokens: Optional[int] = None,
-                           eos: Optional[int] = None) -> bool:
+                           eos: Optional[int] = None,
+                           priority=None) -> bool:
         """Enqueue a *generate* request (ISSUE-10): ``tokens`` is the
         1-D int prompt; ``max_tokens``/``eos`` ride the blob as
         reserved wire keys next to the deadline. Same admission
         control / shedding / False-means-refused contract as
-        :meth:`enqueue`."""
-        if self.shed_depth and self._shed():
+        :meth:`enqueue`, except the admission COST is max_tokens-
+        weighted (ceil(budget / zoo.serving.shed.gen_cost_tokens)) so
+        one long stream cannot starve interactive traffic."""
+        pri = priority_index(priority)
+        budget = (self._gen_default_tokens if max_tokens is None
+                  else max(1, int(max_tokens)))
+        cost = max(1, -(-budget // max(1, self._gen_cost_tokens)))
+        if self.shed_depth and self._shed(
+                self.default_priority if pri is None else pri,
+                cost=cost):
             return False
         deadline = (time.time() + self.deadline_ms / 1000.0
                     if self.deadline_ms else None)
@@ -706,7 +751,8 @@ class InputQueue:
             uri, {"tokens": np.asarray(tokens, np.int32).reshape(-1)},
             reply_to=self.reply_stream,
             trace_id=_tracing.current_trace_id(),
-            deadline=deadline, max_tokens=max_tokens, eos=eos))
+            deadline=deadline, max_tokens=max_tokens, eos=eos,
+            priority=pri))
         _M_ENQ.inc()
         if not ok:
             _M_ENQ_REJECTED.inc()

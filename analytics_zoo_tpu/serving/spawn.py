@@ -1,0 +1,271 @@
+"""Pluggable replica spawn backends for the serving fleet (ISSUE-15).
+
+The :class:`~analytics_zoo_tpu.serving.fleet.FleetController` used to
+``subprocess.Popen`` its replicas inline, which welded the fleet's
+control plane (supervision, health, scaling, rolling restarts) to one
+deployment substrate: local OS processes. This module extracts that
+seam behind :class:`SpawnBackend` so the SAME control plane drives:
+
+- :class:`LocalSpawnBackend` -- the historical behavior, byte for
+  byte: one launcher process per replica, ``start_new_session``, log
+  file capture, /proc-identity guarded signaling. The default; every
+  existing fleet test passes against it unchanged.
+- :class:`ManifestSpawnBackend` -- spawns nothing. It records each
+  replica the controller asked for and renders the equivalent
+  **docker-compose** and **Kubernetes** manifests
+  (:meth:`~ManifestSpawnBackend.compose_yaml` /
+  :meth:`~ManifestSpawnBackend.k8s_yaml`), with host paths rewritten
+  to stable container paths so the output is machine-independent and
+  golden-testable. ``kill`` / ``signal`` flip the synthetic handle's
+  state the way a real exit would, so controller logic (supervision,
+  rolling restarts, chaos kills) can be exercised against it without
+  processes.
+
+A backend hands back a *handle* with the ``subprocess.Popen`` surface
+the controller relies on (``pid`` / ``poll`` / ``returncode`` /
+``wait``); all signaling goes through the backend (never bare
+``os.kill``), which is what lets the manifest backend intercept it.
+
+``zoo.serving.fleet.spawn_backend`` selects the backend by name
+(:func:`make_spawn_backend`); tests and tools may also inject an
+instance directly into the controller.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from analytics_zoo_tpu.common.log import get_logger
+from analytics_zoo_tpu.serving.manager import _proc_identity
+
+logger = get_logger(__name__)
+
+
+class SpawnBackend:
+    """What the fleet needs from a deployment substrate.
+
+    Subclasses implement how a replica comes to exist and how it is
+    signaled; the controller owns everything else (naming, config
+    files, readiness, health, backoff)."""
+
+    name = "abstract"
+
+    def spawn(self, name: str, argv: Sequence[str], log_path: str,
+              env: Dict[str, str]):
+        """Bring one replica up; returns a Popen-like handle."""
+        raise NotImplementedError
+
+    def identity(self, handle) -> Optional[tuple]:
+        """Spawn-time identity fingerprint, or None when the
+        substrate cannot provide one."""
+        raise NotImplementedError
+
+    def identity_matches(self, handle, identity) -> bool:
+        """True unless the handle provably now names a DIFFERENT
+        process than ``identity`` fingerprinted at spawn (the
+        recycled-pid guard). Unknowable must answer True: the local
+        rule is "cannot disprove, may signal"."""
+        raise NotImplementedError
+
+    def signal(self, handle, sig: int) -> None:
+        """Deliver ``sig`` to the replica behind ``handle``. May
+        raise ProcessLookupError/PermissionError like ``os.kill``."""
+        raise NotImplementedError
+
+
+class LocalSpawnBackend(SpawnBackend):
+    """OS processes on this host -- the historical inline behavior."""
+
+    name = "local"
+
+    def spawn(self, name: str, argv: Sequence[str], log_path: str,
+              env: Dict[str, str]) -> subprocess.Popen:
+        log_f = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                list(argv), stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True, env=env)
+        finally:
+            log_f.close()
+        return proc
+
+    def identity(self, handle) -> Optional[tuple]:
+        return _proc_identity(handle.pid)
+
+    def identity_matches(self, handle, identity) -> bool:
+        # STARTTIME-only /proc check (the manager.py rule): two
+        # processes can share a recycled pid, never a (pid,
+        # starttime) pair; cmdline legitimately changes across exec
+        if identity is None or handle is None:
+            return True  # no /proc at spawn: cannot disprove
+        now = _proc_identity(handle.pid)
+        return now is None or now[0] == identity[0]
+
+    def signal(self, handle, sig: int) -> None:
+        os.kill(handle.pid, sig)
+
+
+class _ManifestHandle:
+    """Synthetic Popen-surface handle for a replica that exists only
+    in a rendered manifest. Signals flip it to exited, so controller
+    state machines run against it exactly as against a process."""
+
+    def __init__(self, name: str, pid: int):
+        self.name = name
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self._cond = threading.Condition()
+
+    def poll(self) -> Optional[int]:
+        return self.returncode
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        with self._cond:
+            if self.returncode is None:
+                self._cond.wait(timeout)
+            if self.returncode is None:
+                raise subprocess.TimeoutExpired(
+                    cmd=f"manifest:{self.name}", timeout=timeout or 0)
+            return self.returncode
+
+    def send_signal(self, sig: int) -> None:
+        with self._cond:
+            if self.returncode is None:
+                # a manifest replica "exits" the instant it is
+                # signaled -- Popen's negative-signal convention
+                self.returncode = -int(sig)
+                self._cond.notify_all()
+
+    def kill(self) -> None:
+        self.send_signal(int(_signal.SIGKILL))
+
+
+class ManifestSpawnBackend(SpawnBackend):
+    """Records the fleet as deployment manifests instead of running
+    it. Pseudo-pids start at 100000 -- far above real pid ranges, so
+    a bug that ever routed one into ``os.kill`` would fail loudly.
+
+    Host paths (per-replica YAML, logs) are rewritten to fixed
+    container paths (``/etc/zoo``, ``/var/log/zoo``) so the rendered
+    YAML is independent of the controller's work_dir and python --
+    the property the golden tests pin."""
+
+    name = "manifest"
+    CONFIG_DIR = "/etc/zoo"
+    LOG_DIR = "/var/log/zoo"
+
+    def __init__(self, image: str = "analytics-zoo-tpu:latest",
+                 namespace: str = "zoo-serving"):
+        self.image = image
+        self.namespace = namespace
+        self._next_pid = 100000
+        self._lock = threading.Lock()
+        self.records: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------ backend --
+    def spawn(self, name: str, argv: Sequence[str], log_path: str,
+              env: Dict[str, str]) -> _ManifestHandle:
+        argv = list(argv)
+        # replica argv shape: [python, -m, module, *flags] -- inside
+        # the container the interpreter is just "python" and file
+        # flags point at the mounted config dir
+        command = ["python"] + [
+            a if i == 0 or not os.path.isabs(a)
+            else f"{self.CONFIG_DIR}/{os.path.basename(a)}"
+            for i, a in enumerate(argv[1:])]
+        with self._lock:
+            pid = self._next_pid
+            self._next_pid += 1
+            self.records.append({"name": name, "command": command})
+        logger.info("manifest backend recorded replica %s "
+                    "(pseudo-pid %d)", name, pid)
+        return _ManifestHandle(name, pid)
+
+    def identity(self, handle) -> Optional[tuple]:
+        return ("manifest", handle.pid)
+
+    def identity_matches(self, handle, identity) -> bool:
+        return True  # nothing to recycle: handles are never reused
+
+    def signal(self, handle, sig: int) -> None:
+        handle.send_signal(sig)
+
+    # ------------------------------------------------------- render --
+    def compose_yaml(self) -> str:
+        """docker-compose v3 manifest: one service per replica, the
+        shared config volume, and the exact launcher command line."""
+        import yaml
+
+        services: Dict[str, Any] = {}
+        for rec in sorted(self.records, key=lambda r: r["name"]):
+            services[rec["name"]] = {
+                "image": self.image,
+                "command": rec["command"],
+                "restart": "unless-stopped",
+                "volumes": [
+                    f"./config:{self.CONFIG_DIR}:ro",
+                    f"./logs/{rec['name']}:{self.LOG_DIR}",
+                ],
+            }
+        doc = {"version": "3.8", "services": services}
+        return yaml.safe_dump(doc, sort_keys=True,
+                              default_flow_style=False)
+
+    def k8s_yaml(self) -> str:
+        """Kubernetes manifest: one Pod per replica (the controller
+        IS the replica supervisor -- a Deployment's replica count
+        would fight the fleet's own autoscaler) plus the shared
+        ConfigMap reference."""
+        import yaml
+
+        docs: List[Dict[str, Any]] = []
+        for rec in sorted(self.records, key=lambda r: r["name"]):
+            docs.append({
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": rec["name"],
+                    "namespace": self.namespace,
+                    "labels": {"app": "zoo-serving",
+                               "replica": rec["name"]},
+                },
+                "spec": {
+                    "restartPolicy": "Always",
+                    "containers": [{
+                        "name": "serving",
+                        "image": self.image,
+                        "command": rec["command"],
+                        "volumeMounts": [{
+                            "name": "zoo-config",
+                            "mountPath": self.CONFIG_DIR,
+                            "readOnly": True,
+                        }],
+                    }],
+                    "volumes": [{
+                        "name": "zoo-config",
+                        "configMap": {"name": "zoo-serving-config"},
+                    }],
+                },
+            })
+        return yaml.safe_dump_all(docs, sort_keys=True,
+                                  default_flow_style=False)
+
+
+def make_spawn_backend(name: Optional[str] = None) -> SpawnBackend:
+    """Backend by name; None reads ``zoo.serving.fleet.spawn_backend``
+    (enum-validated by the config layer: local | manifest)."""
+    if name is None:
+        from analytics_zoo_tpu.common.config import get_config
+
+        name = str(get_config().get("zoo.serving.fleet.spawn_backend",
+                                    "local"))
+    if name == "local":
+        return LocalSpawnBackend()
+    if name == "manifest":
+        return ManifestSpawnBackend()
+    raise ValueError(
+        f"unknown spawn backend {name!r}: expected local | manifest")

@@ -48,7 +48,8 @@ from analytics_zoo_tpu.obs.tracing import get_tracer
 from analytics_zoo_tpu.serving.batcher import AdaptiveBatcher, MicroBatcher
 from analytics_zoo_tpu.serving.chaos import chaos_point
 from analytics_zoo_tpu.serving.protocol import (
-    CIRCUIT_PREFIX, DEADLINE_PREFIX, ERROR_KEY, INVALID_PREFIX)
+    CIRCUIT_PREFIX, DEADLINE_PREFIX, ERROR_KEY, INVALID_PREFIX,
+    priority_index, priority_name)
 from analytics_zoo_tpu.serving.queues import _decode_predict, _encode
 from analytics_zoo_tpu.serving.timer import Timer
 
@@ -91,6 +92,11 @@ _M_DEADLINE = _REG.counter(
     "zoo_serving_deadline_exceeded_total",
     "Requests rejected for missing their zoo.serving.deadline_ms "
     "budget (the catching stage rides the error message/event)")
+_M_CLASS = _REG.counter(
+    "zoo_serving_class_requests_total",
+    "Requests decoded by the worker, by admission class (ISSUE-15; "
+    "requests without __priority__ count as the default class)",
+    labelnames=("class",))
 
 # ERROR_KEY / DEADLINE_PREFIX / CIRCUIT_PREFIX are re-exported above
 # from serving.protocol -- the wire vocabulary's one declaring module
@@ -345,6 +351,11 @@ class ServingWorker:
         # anyway is a structured 400 -- one getattr at construction,
         # zero per-request cost on the no-tenant path
         self._tenant_lanes = getattr(model, "tenant_lanes", None)
+        # admission class of requests without __priority__ (ISSUE-15):
+        # resolved once so the per-request counter pays one list index
+        self._default_priority = priority_index(
+            cfg.get("zoo.serving.priority.default_class",
+                    "interactive")) or 0
         if breaker is None and bool(
                 cfg.get("zoo.serving.breaker.enabled", False)):
             from analytics_zoo_tpu.serving.resilience import (
@@ -435,13 +446,15 @@ class ServingWorker:
         """Wire-decode a pulled micro-batch, then image-decode through
         the shared thread pool. Returns (items, failures,
         decode_seconds); items are (uri, tensors, reply, trace,
-        deadline, tenant), failures are (uri, reply, message) --
-        undecodable images plus requests already past their deadline."""
+        deadline, tenant, priority), failures are (uri, reply,
+        message) -- undecodable images plus requests already past
+        their deadline."""
         t0 = time.perf_counter()
         with self.timer.timing("decode", batch=len(blobs)):
             items: List[Tuple[str, Dict[str, np.ndarray],
                               Optional[str], Optional[str],
-                              Optional[float], Optional[int]]]
+                              Optional[float], Optional[int],
+                              Optional[int]]]
             try:  # fast path: no per-item try frames on clean batches
                 items = [_decode_predict(b) for b in blobs]
                 if self.ledger is not None:
@@ -465,6 +478,12 @@ class ServingWorker:
             # (the only residual uncovered window is the wire-decode
             # loop itself)
             chaos_point("decode")
+            for it in items:
+                # per-class traffic counter (ISSUE-15): requests
+                # without __priority__ count as the default class
+                pri = it[6] if len(it) > 6 and it[6] is not None \
+                    else self._default_priority
+                _M_CLASS.labels(**{"class": priority_name(pri)}).inc()
             items, bad_images = decode_image_batch(items)
             items, expired = self._split_expired(items, "decode")
         t1 = time.perf_counter()

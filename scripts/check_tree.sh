@@ -43,6 +43,8 @@ python -m pytest tests/test_zoolint.py tests/test_zoolint_lifecycle.py \
 if [ "$SOAK" = 1 ]; then
     echo "== fleet chaos soak (smoke) =="
     python scripts/fleet_soak.py --smoke
+    echo "== fleet overload soak (zipf smoke) =="
+    python scripts/fleet_soak.py --zipf --smoke
     echo "== generation soak (smoke) =="
     python scripts/perf_generation.py --smoke
     echo "== automl vectorized A/B (smoke) =="

@@ -787,11 +787,15 @@ class TestGenerationProtocol:
 
         blob = _encode("u1", {"tokens": np.arange(4, dtype=np.int32)},
                        max_tokens=9, eos=3, deadline=123.5)
-        uri, tensors, reply, trace, deadline, mt, eos = \
+        uri, tensors, reply, trace, deadline, mt, eos, pri = \
             _decode_generation(blob)
         assert uri == "u1"
         assert list(tensors) == ["tokens"]
         assert (mt, eos, deadline) == (9, 3, 123.5)
+        assert pri is None  # no __priority__ on the wire
+        blob2 = _encode("u2", {"tokens": np.arange(4, dtype=np.int32)},
+                        max_tokens=9, priority=1)
+        assert _decode_generation(blob2)[7] == 1
         # predict-path decode strips the generation keys from tensors
         from analytics_zoo_tpu.serving.queues import _decode_request
 

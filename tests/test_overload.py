@@ -1,0 +1,530 @@
+"""SLO-aware overload control plane (ISSUE-15): priority classes on
+the wire, the brownout admission ladder, adaptive Retry-After,
+SLO-driven autoscaling, targeted replica re-probes, and the pluggable
+spawn backend.
+
+The contracts under test:
+- **no priority inversion**: over randomized admission sequences, the
+  controller never refuses a class while admitting a lower one at the
+  same depth/cost;
+- **monotone Retry-After**: consecutive refusals advertise a
+  non-decreasing backoff (floor first, capped), and admitted traffic
+  decays it back;
+- **no flapping**: an SLO attainment signal that oscillates around the
+  target moves the autoscaler zero times; a sustained breach scales up
+  within the configured streak;
+- **spawn-backend equivalence**: the local backend is the historical
+  Popen behavior; the manifest backend renders golden-pinned compose /
+  k8s YAML and drives the same controller state machines.
+"""
+
+import json
+import os
+import random
+import signal
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.obs.events import EVENT_TYPES, get_event_log
+from analytics_zoo_tpu.serving.admission import AdmissionController
+from analytics_zoo_tpu.serving.fleet import (
+    Autoscaler, FleetController, Replica)
+from analytics_zoo_tpu.serving.protocol import (
+    PRIORITY_CLASSES, PRIORITY_KEY, priority_index, priority_name)
+from analytics_zoo_tpu.serving.queues import (
+    InputQueue, OutputQueue, _decode_generation, _decode_predict,
+    _encode)
+from analytics_zoo_tpu.serving.spawn import (
+    LocalSpawnBackend, ManifestSpawnBackend, make_spawn_backend)
+
+GOLDEN = Path(__file__).parent / "golden"
+
+
+def _x():
+    return np.zeros(2, np.float32)
+
+
+def _events_since(seq0, type=None):
+    return [e for e in get_event_log().tail(500)
+            if e["seq"] > seq0 and (type is None or e["type"] == type)]
+
+
+# ------------------------------------------------------ wire format --
+class TestPriorityWire:
+    def test_class_vocabulary(self):
+        assert PRIORITY_CLASSES == ("interactive", "batch",
+                                    "background")
+        assert priority_index("interactive") == 0
+        assert priority_index("background") == 2
+        assert priority_index(1) == 1
+        assert priority_index(None) is None
+        assert priority_index("urgent") is None
+        assert priority_index(7) is None
+        assert priority_name(0) == "interactive"
+        # a garbled byte must never PROMOTE a request
+        assert priority_name(99) == "background"
+        assert priority_name(-3) == "background"
+
+    def test_roundtrip_and_requeue_survival(self):
+        blob = _encode("u", {"x": _x()}, priority=2)
+        assert _decode_predict(blob)[6] == 2
+        assert _decode_generation(blob)[7] == 2
+        # requeue re-enqueues the RAW blob, so the class survives a
+        # worker restart by construction -- same bytes, same decode
+        assert _decode_predict(bytes(blob))[6] == 2
+
+    def test_absent_priority_is_byte_identical(self):
+        b0 = _encode("u", {"x": _x()})
+        assert _decode_predict(b0)[6] is None
+        assert PRIORITY_KEY.encode() not in b0
+
+
+# ------------------------------------------------- admission ladder --
+class TestAdmissionLadder:
+    def _ac(self, depth=10, **kw):
+        kw.setdefault("batch_fraction", 0.6)
+        kw.setdefault("background_fraction", 0.3)
+        kw.setdefault("retry_after_s", 1.0)
+        kw.setdefault("retry_after_max_s", 30.0)
+        kw.setdefault("ewma_alpha", 0.2)
+        return AdmissionController(depth, **kw)
+
+    def test_ladder_thresholds(self):
+        ac = self._ac(10)
+        assert ac.thresholds == (10, 6, 3)
+        assert self._ac(0).enabled is False
+        assert self._ac(0).admit(10 ** 6, 2)  # disabled admits all
+
+    def test_ladder_monotone_for_any_fractions(self):
+        rng = random.Random(3)
+        for _ in range(200):
+            t = AdmissionController._ladder(
+                rng.randrange(1, 50),
+                (1.0, rng.uniform(-0.5, 1.5), rng.uniform(-0.5, 1.5)))
+            assert list(t) == sorted(t, reverse=True)
+            assert all(v >= 0 for v in t)
+
+    def test_no_priority_inversion_randomized(self):
+        """The acceptance property: over randomized admission
+        sequences there is NO decision that admits a class while
+        refusing a higher one at the same depth/cost."""
+        rng = random.Random(7)
+        ac = self._ac(10)
+        inversions = 0
+        for _ in range(2000):
+            depth = rng.randrange(0, 15)
+            cost = rng.randrange(1, 4)
+            decisions = [ac.admit(depth, pri, cost=cost)
+                         for pri in range(len(PRIORITY_CLASSES))]
+            for hi in range(len(decisions)):
+                for lo in range(hi + 1, len(decisions)):
+                    if decisions[lo] and not decisions[hi]:
+                        inversions += 1
+        assert inversions == 0
+
+    def test_garbage_priority_clamps_to_lowest(self):
+        ac = self._ac(10)
+        # depth 5: background (threshold 3) refused, interactive ok
+        assert ac.admit(5, 0)
+        assert not ac.admit(5, None)
+        assert not ac.admit(5, 99)
+        assert not ac.admit(5, "interactive")  # non-int is garbage
+
+    def test_per_class_shed_counts_and_episode_events(self):
+        seq0 = get_event_log().tail()[-1]["seq"] \
+            if get_event_log().tail() else 0
+        ac = self._ac(10)
+        for _ in range(4):
+            ac.admit(5, 2)  # background refused x4: ONE episode
+        ac.admit(20, 0)     # interactive refused: its own episode
+        counts = ac.shed_counts()
+        assert counts["background"] == 4
+        assert counts["interactive"] == 1
+        evs = _events_since(seq0, type="request_shed")
+        assert len(evs) == 2
+        assert {e["fields"]["priority"] for e in evs} == {
+            "background", "interactive"}
+
+    def test_retry_after_floor_then_monotone_then_decay(self):
+        ac = self._ac(1, ewma_alpha=0.5)
+        assert ac.retry_after_s() == pytest.approx(1.0)
+        ac.admit(5, 0)  # first shed of a calm queue: exactly floor
+        assert ac.retry_after_s() == pytest.approx(1.0)
+        prev = ac.retry_after_s()
+        seen = [prev]
+        for _ in range(20):
+            ac.admit(5, 0)
+            cur = ac.retry_after_s()
+            assert cur >= prev - 1e-9, "Retry-After went DOWN under " \
+                                       "sustained shedding"
+            prev = cur
+            seen.append(cur)
+        assert seen[-1] > 1.0 and seen[-1] <= 30.0
+        peak = seen[-1]
+        for _ in range(20):
+            assert ac.admit(0, 0)  # calm traffic decays pressure
+        ac.admit(5, 0)  # next refusal advertises less than the peak
+        assert ac.retry_after_s() < peak
+
+
+# --------------------------------------------- InputQueue integration --
+class TestQueueBrownout:
+    def test_brownout_ladder_on_enqueue(self):
+        in_q = InputQueue(shed_depth=10)
+        for i in range(3):
+            assert in_q.enqueue(f"i{i}", priority="interactive",
+                                x=_x())
+        # depth 3 = the background threshold (ceil(10 * 0.3))
+        assert not in_q.enqueue("bg", priority="background", x=_x())
+        assert in_q.enqueue("b0", priority="batch", x=_x())
+        assert in_q.enqueue("b1", priority="batch", x=_x())
+        assert in_q.enqueue("b2", priority="batch", x=_x())
+        # depth 6 = the batch threshold (ceil(10 * 0.6))
+        assert not in_q.enqueue("b3", priority="batch", x=_x())
+        for i in range(4):
+            assert in_q.enqueue(f"j{i}", priority="interactive",
+                                x=_x())
+        assert not in_q.enqueue("j4", priority="interactive", x=_x())
+        assert len(in_q) == 10
+
+    def test_priorityless_enqueue_admits_as_default_class(self):
+        # historical single-threshold behavior: priority-less traffic
+        # is the default (interactive) class, shed only at queue_depth
+        in_q = InputQueue(shed_depth=3)
+        for i in range(3):
+            assert in_q.enqueue(f"s{i}", x=_x())
+        assert not in_q.enqueue("s3", x=_x())
+
+    def test_generation_cost_weighting(self):
+        in_q = InputQueue(shed_depth=4)  # gen_cost_tokens default 16
+        toks = np.arange(3, dtype=np.int32)
+        assert in_q.enqueue_generation("g0", toks, max_tokens=64)
+        # depth 1 + cost ceil(64/16)=4 overshoots the depth-4 bar
+        assert not in_q.enqueue_generation("g1", toks, max_tokens=64)
+        # a short stream still fits
+        assert in_q.enqueue_generation("g2", toks, max_tokens=16)
+
+    def test_http_unknown_priority_is_400(self):
+        from analytics_zoo_tpu.serving.http_frontend import (
+            HttpFrontend)
+
+        fe = HttpFrontend(InputQueue(), OutputQueue()).start()
+        try:
+            body = json.dumps({"inputs": {"x": [1.0, 2.0]}}).encode()
+            req = urllib.request.Request(
+                fe.address + "/predict", data=body,
+                headers={"Content-Type": "application/json",
+                         "X-Priority": "urgent"})
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 400
+            assert "priority" in json.loads(
+                exc.value.read())["error"]
+        finally:
+            fe.stop()
+
+
+# ------------------------------------------------- SLO autoscaler --
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _slo_scaler(clock, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 8)
+    kw.setdefault("backlog_high", 10 ** 9)
+    kw.setdefault("backlog_low", 0)
+    kw.setdefault("p99_high_ms", 0.0)
+    kw.setdefault("up_consecutive", 3)
+    kw.setdefault("down_consecutive", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("slo_enabled", True)
+    kw.setdefault("slo_p99_ms", 500.0)
+    kw.setdefault("slo_ttft_ms", 200.0)
+    kw.setdefault("slo_inter_token_ms", 50.0)
+    return Autoscaler(clock=clock, **kw)
+
+
+class TestSloAutoscaler:
+    def test_breach_detection(self):
+        a = _slo_scaler(_Clock())
+        assert a.slo_breaches(p99_ms=600.0) == ["p99_ms"]
+        assert a.slo_breaches(ttft_p99_ms=300.0,
+                              inter_token_p99_ms=80.0) == [
+            "ttft_ms", "inter_token_ms"]
+        assert a.slo_breaches(p99_ms=400.0) == []
+        assert a.slo_breaches() == []  # no samples cannot breach
+        # the 2x-headroom question the underload check asks
+        assert a.slo_breaches(p99_ms=300.0, margin=0.5) == ["p99_ms"]
+        assert a.slo_breaches(p99_ms=200.0, margin=0.5) == []
+
+    def test_oscillating_attainment_never_moves(self):
+        """The no-flapping acceptance evidence: SLO attainment that
+        oscillates around the target yields ZERO scale actions."""
+        clk = _Clock()
+        a = _slo_scaler(clk)
+        moves = []
+        for i in range(50):
+            clk.t += 1.0
+            ttft = 900.0 if i % 2 == 0 else 100.0  # breach, recover
+            moves.append(a.decide(2, backlog=0, ttft_p99_ms=ttft))
+        assert moves == [0] * 50
+
+    def test_sustained_breach_scales_up_within_streak(self):
+        clk = _Clock()
+        a = _slo_scaler(clk, up_consecutive=3)
+        decisions = []
+        for _ in range(3):
+            clk.t += 1.0
+            decisions.append(a.decide(2, backlog=0, ttft_p99_ms=900.0))
+        assert decisions == [0, 0, 1], \
+            "scale-up must land exactly at the breach streak"
+
+    def test_high_class_shed_is_overload(self):
+        clk = _Clock()
+        a = _slo_scaler(clk, up_consecutive=2)
+        clk.t += 1.0
+        assert a.decide(2, backlog=0, high_shed_rate=3.0) == 0
+        clk.t += 1.0
+        assert a.decide(2, backlog=0, high_shed_rate=3.0) == 1
+
+    def test_comfortable_attainment_scales_down(self):
+        clk = _Clock()
+        a = _slo_scaler(clk, down_consecutive=3)
+        decisions = []
+        for _ in range(3):
+            clk.t += 20.0  # outruns the cooldown
+            decisions.append(a.decide(
+                4, backlog=0, p99_ms=100.0, ttft_p99_ms=50.0,
+                inter_token_p99_ms=10.0))
+        assert decisions == [0, 0, -1]
+
+    def test_cooldown_blocks_consecutive_actions(self):
+        clk = _Clock()
+        a = _slo_scaler(clk, up_consecutive=1, cooldown_s=10.0)
+        clk.t = 1.0
+        assert a.decide(2, backlog=0, ttft_p99_ms=900.0) == 1
+        clk.t = 2.0  # inside the cooldown window
+        assert a.decide(3, backlog=0, ttft_p99_ms=900.0) == 0
+        clk.t = 20.0
+        assert a.decide(3, backlog=0, ttft_p99_ms=900.0) == 1
+
+    def test_slo_mode_off_keeps_backlog_semantics(self):
+        clk = _Clock()
+        a = Autoscaler(min_replicas=1, max_replicas=8, backlog_high=50,
+                       backlog_low=5, p99_high_ms=0.0,
+                       up_consecutive=1, down_consecutive=10 ** 6,
+                       cooldown_s=0.0, clock=clk, slo_enabled=False)
+        clk.t += 1.0
+        assert a.decide(2, backlog=100) == 1
+
+
+# -------------------------------------------------- replica re-probe --
+def _fleet(tmp_path, **kw):
+    return FleetController({}, replicas=0, work_dir=str(tmp_path),
+                           **kw)
+
+
+def _stub_healthz():
+    from http.server import (BaseHTTPRequestHandler,
+                             ThreadingHTTPServer)
+    import threading
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            code = 503 if srv.down else 200
+            body = b'{"status": "ok"}'
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    srv.down = False
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class TestReprobe:
+    def test_recovered_replica_readmits_without_sweep(self, tmp_path):
+        srv = _stub_healthz()
+        try:
+            fc = _fleet(tmp_path)
+            rep = Replica("r0", "", "", "")
+            rep.address = "http://127.0.0.1:%d" % srv.server_address[1]
+            rep.state = "up"
+            rep.healthy = True
+            fc._replicas["r0"] = rep
+            seq0 = get_event_log().tail()[-1]["seq"]
+            fc.mark_unhealthy(rep, "connect probe failed: test")
+            assert not rep.healthy
+            assert rep.reprobe_at > 0 and rep.probe_failures == 1
+            # the replica was only transiently unreachable: the next
+            # due re-probe re-admits it -- no _health_tick involved
+            time.sleep(fc.reprobe_base_s + 0.01)
+            fc._reprobe_tick()
+            assert rep.healthy and rep.probe_failures == 0
+            evs = _events_since(seq0, type="replica_reprobe")
+            assert len(evs) == 1
+            assert evs[0]["fields"]["outcome"] == "recovered"
+        finally:
+            srv.shutdown()
+
+    def test_backoff_grows_and_caps_while_down(self, tmp_path):
+        srv = _stub_healthz()
+        srv.down = True
+        try:
+            fc = _fleet(tmp_path)
+            fc.reprobe_base_s = 0.001
+            fc.reprobe_max_s = 0.004
+            rep = Replica("r0", "", "", "")
+            rep.address = "http://127.0.0.1:%d" % srv.server_address[1]
+            rep.state = "up"
+            rep.healthy = True
+            fc._replicas["r0"] = rep
+            fc.mark_unhealthy(rep, "x")
+            delays = []
+            for _ in range(6):
+                time.sleep(0.005)  # past any scheduled reprobe
+                before = rep.probe_failures
+                fc._reprobe_tick()
+                assert rep.probe_failures == before + 1
+                delays.append(rep.reprobe_at - time.monotonic())
+            assert not rep.healthy
+            # capped-exponential: later delays never exceed the cap
+            assert all(d <= fc.reprobe_max_s + 1e-6 for d in delays)
+            assert delays[-1] > delays[0], "backoff never grew"
+        finally:
+            srv.shutdown()
+
+
+# ------------------------------------------ rolling-restart SLO gate --
+class TestRollingRestartGate:
+    def test_refuses_while_out_of_slo(self, tmp_path):
+        fc = _fleet(tmp_path)
+        rep = Replica("r0", "", "", "")
+        rep.state = "up"
+        rep.healthy = True
+        fc._replicas["r0"] = rep
+        seq0 = get_event_log().tail()[-1]["seq"]
+        ok = fc.rolling_restart(slo_gate=lambda: False,
+                                slo_wait_s=0.2)
+        assert ok is False
+        assert rep.state == "up", "a blocked restart must not have " \
+                                  "touched the replica"
+        evs = _events_since(seq0, type="rolling_restart")
+        assert any(e["fields"]["phase"] == "slo_blocked" for e in evs)
+        assert evs[-1]["fields"]["phase"] == "end"  # still closed
+
+    def test_gate_defaults_open_without_slo_mode(self, tmp_path):
+        fc = _fleet(tmp_path)
+        assert fc._slo_ok() is True  # no autoscaler -> no gate
+        assert fc.rolling_restart(slo_wait_s=0.1) is True  # no reps
+
+
+# ------------------------------------------------- spawn backends --
+class TestSpawnBackends:
+    def test_local_backend_popen_equivalence(self, tmp_path):
+        be = LocalSpawnBackend()
+        log = tmp_path / "r0.log"
+        h = be.spawn(
+            "r0",
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            str(log), dict(os.environ))
+        try:
+            assert h.poll() is None
+            ident = be.identity(h)
+            assert ident is not None
+            assert be.identity_matches(h, ident)
+            be.signal(h, signal.SIGTERM)
+            assert h.wait(10.0) == -signal.SIGTERM
+        finally:
+            if h.poll() is None:
+                h.kill()
+                h.wait(10.0)
+        assert log.exists()
+
+    def test_manifest_handles_behave_like_processes(self):
+        be = ManifestSpawnBackend()
+        h = be.spawn("r0", ["python3", "-m", "mod"], "/tmp/r0.log", {})
+        assert h.pid >= 100000  # never a real pid
+        assert h.poll() is None
+        with pytest.raises(Exception):
+            h.wait(timeout=0.01)  # still "running"
+        be.signal(h, signal.SIGKILL)
+        assert h.poll() == -signal.SIGKILL
+        assert h.wait(timeout=0.01) == -signal.SIGKILL
+        assert be.identity_matches(h, be.identity(h))
+
+    def test_factory_reads_config(self):
+        assert isinstance(make_spawn_backend(), LocalSpawnBackend)
+        cfg = get_config()
+        cfg.set("zoo.serving.fleet.spawn_backend", "manifest")
+        try:
+            assert isinstance(make_spawn_backend(),
+                              ManifestSpawnBackend)
+        finally:
+            cfg.unset("zoo.serving.fleet.spawn_backend")
+        with pytest.raises(ValueError):
+            make_spawn_backend("bogus")
+
+    def test_controller_lifecycle_through_manifest(self, tmp_path):
+        be = ManifestSpawnBackend()
+        fc = _fleet(tmp_path, spawn_backend=be)
+        rep = fc._spawn()
+        assert rep.proc.poll() is None
+        assert fc.kill_replica(rep.name, reason="drill")
+        assert rep.proc.poll() == -signal.SIGKILL
+        # supervision sees the "exit" and schedules a backoff respawn
+        fc._supervise_tick()
+        assert rep.state == "backoff"
+
+    def test_manifest_yaml_matches_golden(self, tmp_path):
+        be = ManifestSpawnBackend()
+        fc = FleetController({"model": {"kind": "dummy"}}, replicas=0,
+                             work_dir=str(tmp_path), spawn_backend=be)
+        for _ in range(3):
+            fc._spawn()
+        assert be.compose_yaml() == (
+            GOLDEN / "fleet_compose.yaml").read_text()
+        assert be.k8s_yaml() == (
+            GOLDEN / "fleet_k8s.yaml").read_text()
+
+    def test_manifest_yaml_is_valid(self, tmp_path):
+        import yaml
+
+        be = ManifestSpawnBackend()
+        fc = _fleet(tmp_path, spawn_backend=be)
+        for _ in range(3):
+            fc._spawn()
+        compose = yaml.safe_load(be.compose_yaml())
+        assert len(compose["services"]) == 3
+        for svc in compose["services"].values():
+            assert svc["command"][0] == "python"
+            assert any("/etc/zoo" in v for v in svc["volumes"])
+        pods = list(yaml.safe_load_all(be.k8s_yaml()))
+        assert len(pods) == 3
+        assert all(p["kind"] == "Pod" for p in pods)
+        names = [p["metadata"]["name"] for p in pods]
+        assert names == sorted(names)
+
+
+# ------------------------------------------------------- registry --
+class TestEventRegistry:
+    def test_new_event_types_are_declared(self):
+        assert "replica_reprobe" in EVENT_TYPES
+        assert "slo_breach" in EVENT_TYPES
