@@ -100,9 +100,24 @@ DEFAULT_SPECS: Tuple[ResourceSpec, ...] = (
     ResourceSpec(
         name="kv-slot",
         describe="KV-cache slot/page reservation",
-        acquire=("admit", "reserve"),
+        acquire=("admit", "reserve", "import_pages", "import_slot"),
         release=("release", "release_pages", "free"),
         bind="result", release_on="arg"),
+    ResourceSpec(
+        name="kv-handoff",
+        describe="exported KV handoff snapshot",
+        # the disaggregation contract (ISSUE-20): an exported
+        # snapshot must reach import_pages/import_slot (restored
+        # here), _encode_handoff (serialized onto the wire for
+        # another replica), or _discard_handoff (the named
+        # abandonment on an encode-failure path) -- a snapshot
+        # that silently reaches none of them is a stream that will
+        # never resume anywhere
+        acquire=("export_pages", "export_slot"),
+        release=("import_pages", "import_slot", "_encode_handoff",
+                 "_discard_handoff"),
+        bind="result", release_on="arg",
+        exc_safe=True, strict_release=False),
     ResourceSpec(
         name="ledger-entry",
         describe="delivery-ledger entry",

@@ -193,8 +193,46 @@ _DEFAULTS: Dict[str, Any] = {
     # replica spawn backend (ISSUE-15): local = subprocess.Popen on
     # this host (the historical behavior); manifest = no processes,
     # the controller records per-replica configs and emits
-    # docker-compose / k8s YAML -- the multi-host seam
+    # docker-compose / k8s YAML -- the multi-host seam; remote =
+    # launch through a command-runner prefix (ssh/exec style, ISSUE-20)
+    # so replicas run as separate containers/hosts
     "zoo.serving.fleet.spawn_backend": "local",
+    # command-runner prefix for the remote spawn backend, e.g.
+    # "ssh worker-3" or "docker exec zoo-fleet". Tokens are
+    # whitespace-split and prepended to the replica argv; empty = run
+    # the argv directly on this host (the degenerate remote target)
+    "zoo.serving.fleet.remote_runner": "",
+    # cross-host addressing (ISSUE-20): bind_host is the interface the
+    # broker / router / replica HTTP frontends listen on (loopback by
+    # default so single-host behavior is unchanged; 0.0.0.0 for
+    # multi-host). advertise_host is the address OTHER hosts should
+    # use to reach services bound on this host -- it rides the ready
+    # file and broker_address instead of the bind address; empty =
+    # advertise the bind address
+    "zoo.serving.fleet.bind_host": "127.0.0.1",
+    "zoo.serving.fleet.advertise_host": "",
+    # broker liveness probe (ISSUE-20): a PING round trip replicas and
+    # the router use for readiness, retried with capped exponential
+    # backoff before a broker_unreachable event is emitted
+    "zoo.serving.fleet.broker_probe_retries": 6,
+    "zoo.serving.fleet.broker_probe_base_s": 0.05,
+    "zoo.serving.fleet.broker_probe_max_s": 2.0,
+    # disaggregated prefill/decode pools (ISSUE-20): when both are
+    # > 0 the controller spawns role-typed replicas instead of
+    # `replicas` unified ones -- prefill replicas admit + prefill and
+    # hand streams (KV pages + slot state) to the decode pool over the
+    # broker's handoff stream; each pool autoscales independently
+    # within its [min, max]
+    "zoo.serving.fleet.prefill_replicas": 0,
+    "zoo.serving.fleet.decode_replicas": 0,
+    "zoo.serving.fleet.prefill_min_replicas": 1,
+    "zoo.serving.fleet.prefill_max_replicas": 8,
+    "zoo.serving.fleet.decode_min_replicas": 1,
+    "zoo.serving.fleet.decode_max_replicas": 8,
+    # KV snapshots larger than this many bytes are dropped from the
+    # handoff blob (the decode side then re-prefills
+    # deterministically); 0 = always inline the snapshot
+    "zoo.serving.fleet.handoff_max_bytes": 8388608,
     # generation serving (serving/generation, ISSUE-10): the decode
     # slot table size (concurrent streams per worker; ALSO the fixed
     # device batch of every decode step), the paged KV cache geometry
@@ -346,7 +384,21 @@ _SPECS: Dict[str, tuple] = {
     "zoo.serving.slo.inter_token_ms": ("float", 0, None),
     "zoo.serving.fleet.reprobe_base_s": ("float", 0, None),
     "zoo.serving.fleet.reprobe_max_s": ("float", 0, None),
-    "zoo.serving.fleet.spawn_backend": ("enum", "local", "manifest"),
+    "zoo.serving.fleet.spawn_backend": ("enum", "local", "manifest",
+                                        "remote"),
+    "zoo.serving.fleet.remote_runner": ("str",),
+    "zoo.serving.fleet.bind_host": ("str",),
+    "zoo.serving.fleet.advertise_host": ("str",),
+    "zoo.serving.fleet.broker_probe_retries": ("int", 0, None),
+    "zoo.serving.fleet.broker_probe_base_s": ("float", 0, None),
+    "zoo.serving.fleet.broker_probe_max_s": ("float", 0, None),
+    "zoo.serving.fleet.prefill_replicas": ("int", 0, None),
+    "zoo.serving.fleet.decode_replicas": ("int", 0, None),
+    "zoo.serving.fleet.prefill_min_replicas": ("int", 1, None),
+    "zoo.serving.fleet.prefill_max_replicas": ("int", 1, None),
+    "zoo.serving.fleet.decode_min_replicas": ("int", 1, None),
+    "zoo.serving.fleet.decode_max_replicas": ("int", 1, None),
+    "zoo.serving.fleet.handoff_max_bytes": ("int", 0, None),
     "zoo.generation.slots": ("int", 1, None),
     "zoo.generation.page_size": ("int", 1, None),
     "zoo.generation.num_pages": ("int", 0, None),

@@ -196,6 +196,91 @@ class PagedKVCache:
             self._free_slots.append(slot)
             self._free_slots.sort()
 
+    # ------------------------------------------------ page handoff --
+    # ISSUE-20 (disaggregated prefill/decode pools): a prefill replica
+    # serializes a slot's pages and hands the stream to a decode
+    # replica on another host; the snapshot is page-aligned (whole
+    # pages, including the unused tail of the last page) so the
+    # importer writes physical pages verbatim and the decode step
+    # resumes bit-identically.
+
+    def export_pages(self, slot: int) -> Dict[str, Any]:
+        """Serialize ``slot``'s assigned pages + accounting into a
+        host-side snapshot dict (``kv`` [layers, 2, n, page_size,
+        heads, head_dim], ``length`` tokens, ``reserve`` worst-case
+        pages). The slot itself stays admitted -- callers release it
+        (or keep decoding) after the handoff is safely published.
+
+        A successful ``export_pages`` opens an obligation: the
+        snapshot must reach :meth:`import_pages` (possibly on another
+        cache) or the stream's slot must be released -- an exported
+        snapshot abandoned on an error path strands the stream with no
+        owner. zoolint's lifecycle engine proves this per CFG path
+        (``leak-on-path``, kv-handoff spec)."""
+        with self._lock:
+            if slot in self._free_slots:
+                raise ValueError(f"slot {slot} is not admitted")
+            n = int(self._assigned[slot])
+            pages = [int(p) for p in self._block[slot, :n]]
+            length = int(self._length[slot])
+            reserve = int(self._reserve[slot])
+        # gather outside the lock: device -> host copy of n pages
+        kv = np.asarray(self.kv[:, :, np.asarray(pages, np.int32)]) \
+            if pages else np.zeros(
+                (self.num_layers, 2, 0, self.page_size,
+                 self.num_heads, self.head_dim), np.float32)
+        return {"kv": kv, "length": length, "reserve": reserve}
+
+    def import_pages(self, snapshot: Dict[str, Any]) -> int:
+        """Re-admit a handed-off stream from an :meth:`export_pages`
+        snapshot: claims a slot + its worst-case reservation, assigns
+        physical pages for the backed length, and writes the page
+        contents verbatim. Returns the (new) slot id. Raises
+        :class:`CacheOverflow` when no slot / not enough free pages --
+        the importer maps that to the structured ``generation_overflow``
+        refusal, same as first admission -- and :class:`ValueError` on
+        a snapshot whose geometry does not match this pool."""
+        kv = np.asarray(snapshot["kv"])
+        length = int(snapshot["length"])
+        reserve = int(snapshot["reserve"])
+        need = self.pages_for(length)
+        expect = (self.num_layers, 2, need, self.page_size,
+                  self.num_heads, self.head_dim)
+        if kv.shape != expect:
+            raise ValueError(
+                f"snapshot geometry {kv.shape} does not match pool "
+                f"{expect}")
+        if reserve < need:
+            raise ValueError(
+                f"snapshot reserve {reserve} pages < backed {need}")
+        with self._lock:
+            if reserve * self.page_size > self.max_len:
+                raise CacheOverflow(
+                    f"snapshot reservation of {reserve} pages exceeds "
+                    f"max_len {self.max_len}")
+            avail = len(self._free_pages) - self._unassigned_reserved
+            if not self._free_slots or reserve > avail:
+                raise CacheOverflow(
+                    f"kv cache exhausted: need {reserve} pages to "
+                    f"import a {length}-token stream, "
+                    f"{max(0, avail)} free "
+                    f"(slots free: {len(self._free_slots)})")
+            slot = self._free_slots.pop(0)
+            self._reserve[slot] = reserve
+            self._block[slot, :] = 0
+            pages = [self._free_pages.pop(0) for _ in range(need)]
+            for i, page in enumerate(pages):
+                self._block[slot, i] = page
+            self._assigned[slot] = need
+            self._length[slot] = length
+            self._unassigned_reserved += reserve - need
+        if pages:
+            # scatter outside the lock: host -> device page writes
+            idx = np.asarray(pages, np.int32)
+            self.kv = self.kv.at[:, :, idx].set(
+                kv.astype(self.kv.dtype))
+        return slot
+
     # ---------------------------------------------------- step views --
     def block_tables(self) -> np.ndarray:
         """[num_slots, pages_per_slot] int32 physical-page map (0 =

@@ -137,6 +137,20 @@ EVENT_TYPES: Dict[str, str] = {
     "stream_reclaim": "a consumer reclaimed pending stream entries "
                       "owned by a dead/stalled consumer "
                       "(fields: stream, group, n)",
+    # disaggregated fleet (ISSUE-20)
+    "broker_unreachable": "the stream broker failed its PING liveness "
+                          "probe after capped-backoff retries "
+                          "(fields: address, retries, waited_s)",
+    "kv_handoff": "a prefill (or draining decode) replica exported a "
+                  "stream's KV pages + replay state and published it "
+                  "on the handoff stream (fields: uri, slot, "
+                  "prompt_len; inline_kv=0 means the snapshot was "
+                  "dropped for size and the decode side re-prefills; "
+                  "moved=1 marks a drain-time re-handoff)",
+    "kv_import": "a decode replica restored a handed-off stream "
+                 "(fields: uri, slot, produced; regenerated=1 means "
+                 "the KV snapshot was absent/unusable and the stream "
+                 "was deterministically re-prefilled)",
     # generation serving (ISSUE-10)
     "generation_admit": "a generate request joined the running decode "
                         "batch: prefill done, slot + KV pages "

@@ -102,11 +102,15 @@ class Replica:
     readiness/address channel, and routing state."""
 
     def __init__(self, name: str, config_path: str, ready_file: str,
-                 log_path: str):
+                 log_path: str, role: str = "unified"):
         self.name = name
         self.config_path = config_path
         self.ready_file = ready_file
         self.log_path = log_path
+        # unified | prefill | decode (ISSUE-20): which pool this
+        # replica serves; respawns preserve it (a decode consumer
+        # name reborn as prefill would strand its reclaimed handoffs)
+        self.role = role
         self.proc: Optional[subprocess.Popen] = None
         self.identity = None
         self.address: Optional[str] = None
@@ -305,9 +309,15 @@ class FleetRouter:
     the controller-process registry and fleet stats."""
 
     def __init__(self, controller: "FleetController",
-                 host: str = "127.0.0.1", port: int = 0,
+                 host: Optional[str] = None, port: int = 0,
                  retries: Optional[int] = None,
                  timeout_s: float = 30.0):
+        if host is None:
+            # loopback unless the deployment opts into a routable bind
+            # (zoo.serving.fleet.bind_host, e.g. 0.0.0.0 in a
+            # container) -- single-host fleets keep their closed posture
+            host = str(get_config().get(
+                "zoo.serving.fleet.bind_host", "127.0.0.1"))
         self.controller = controller
         self.retries = int(
             get_config().get("zoo.serving.fleet.router_retries", 1)
@@ -428,8 +438,20 @@ class FleetRouter:
         - a reply-phase timeout may be MID-SERVE: retrying could
           double-serve, so surface the 504 instead."""
         tried: List[str] = []
+        # disaggregated pools (ISSUE-20): /generate must land on a
+        # PREFILL replica -- its frontend owns the reply stream the
+        # decode pool pushes chunks to, and a decode replica's gen
+        # input is the handoff stream (a raw client request there is
+        # a routing bug by protocol). /predict shards over everyone.
+        role = ("prefill"
+                if path == "/generate"
+                and getattr(self.controller, "disaggregated", False)
+                else None)
         for attempt in range(self.retries + 1):
-            rep = self.controller.pick_replica(exclude=tried)
+            rep = (self.controller.pick_replica(exclude=tried,
+                                                role=role)
+                   if role is not None
+                   else self.controller.pick_replica(exclude=tried))
             if rep is None:
                 break
             tried.append(rep.name)
@@ -548,8 +570,19 @@ class FleetRouter:
     def health(self):
         counts = self.controller.replica_states()
         healthy = counts.get("healthy", 0)
-        return (200 if healthy > 0 else 503), {
-            "status": "ok" if healthy > 0 else "no_healthy_replicas",
+        # broker liveness rides the health answer (ISSUE-20): healthy
+        # replicas cannot serve stream traffic through a dead data
+        # plane, so a failed PING probe is a fleet-level 503 even with
+        # green replicas. Throttled to one probe per interval so a
+        # health-poll storm does not turn into a connect storm.
+        broker_ok = self.controller.probe_broker_cached()
+        ok = healthy > 0 and broker_ok
+        status = ("ok" if ok
+                  else "broker_unreachable" if not broker_ok
+                  else "no_healthy_replicas")
+        return (200 if ok else 503), {
+            "status": status,
+            "broker": "ok" if broker_ok else "unreachable",
             "replicas": counts,
         }
 
@@ -570,8 +603,9 @@ class FleetController:
     def __init__(self, config: Dict[str, Any],
                  replicas: Optional[int] = None,
                  work_dir: Optional[str] = None,
-                 host: str = "127.0.0.1", broker_port: int = 0,
+                 host: Optional[str] = None, broker_port: int = 0,
                  router_port: int = 0,
+                 advertise_host: Optional[str] = None,
                  stream: str = "serving_stream",
                  group: str = "serving",
                  seed: int = 0,
@@ -581,7 +615,11 @@ class FleetController:
                  on_result: Optional[Callable] = None,
                  poll_interval_s: Optional[float] = None,
                  health_interval_s: Optional[float] = None,
-                 spawn_backend: Optional[SpawnBackend] = None):
+                 spawn_backend: Optional[SpawnBackend] = None,
+                 prefill_replicas: Optional[int] = None,
+                 decode_replicas: Optional[int] = None,
+                 prefill_autoscaler: Optional[Autoscaler] = None,
+                 decode_autoscaler: Optional[Autoscaler] = None):
         cfg = get_config()
         self.config = dict(config)
         self.n_target = int(cfg.get("zoo.serving.fleet.replicas", 2)
@@ -592,11 +630,42 @@ class FleetController:
             work_dir = tempfile.mkdtemp(prefix="zoo-fleet-")
         self.work_dir = work_dir
         os.makedirs(work_dir, exist_ok=True)
-        self.host = host
+        # bind vs advertise (ISSUE-20): the broker/router BIND
+        # bind_host (loopback by default; 0.0.0.0 for cross-host
+        # fleets); replicas are pointed at advertise_host when set --
+        # the address reachable FROM the replica's host, which a
+        # 0.0.0.0 bind is not
+        self.host = (str(cfg.get("zoo.serving.fleet.bind_host",
+                                 "127.0.0.1"))
+                     if host is None else host)
+        self.advertise_host = (
+            str(cfg.get("zoo.serving.fleet.advertise_host", "") or "")
+            if advertise_host is None else advertise_host)
         self._broker_port = broker_port
         self._router_port = router_port
         self.stream = stream
         self.group = group
+        # disaggregated pools (ISSUE-20): both counts > 0 splits the
+        # generation plane into a prefill pool (admission + prefill +
+        # KV handoff) and a decode pool (handoff-stream consumers)
+        self.prefill_target = int(
+            cfg.get("zoo.serving.fleet.prefill_replicas", 0)
+            if prefill_replicas is None else prefill_replicas)
+        self.decode_target = int(
+            cfg.get("zoo.serving.fleet.decode_replicas", 0)
+            if decode_replicas is None else decode_replicas)
+        self.disaggregated = (self.prefill_target > 0
+                              and self.decode_target > 0)
+        gen_block = dict(self.config.get("generation") or {})
+        self.handoff_stream = str(gen_block.get(
+            "handoff_stream", "generation_handoff_stream"))
+        self.gen_stream = str(gen_block.get(
+            "stream", "generation_stream"))
+        if self.disaggregated and "generation" not in self.config:
+            raise ValueError(
+                "disaggregated pools need a generation: block in the "
+                "replica config -- prefill/decode roles are a "
+                "generation-plane split")
         self.poll_interval_s = float(
             cfg.get("zoo.serving.fleet.poll_interval_s", 0.5)
             if poll_interval_s is None else poll_interval_s)
@@ -608,6 +677,28 @@ class FleetController:
             if autoscale is None else autoscale)
         self.autoscaler = autoscaler or (Autoscaler()
                                          if self.autoscale else None)
+        # per-pool scaling (ISSUE-20): each pool gets its own
+        # streak/cooldown state and its own [min, max] -- prefill
+        # demand (admissions) and decode demand (live streams) move
+        # independently, so one shared autoscaler would couple them
+        if self.disaggregated and (self.autoscale
+                                   or prefill_autoscaler is not None):
+            self.prefill_autoscaler = prefill_autoscaler or Autoscaler(
+                min_replicas=int(cfg.get(
+                    "zoo.serving.fleet.prefill_min_replicas", 1)),
+                max_replicas=int(cfg.get(
+                    "zoo.serving.fleet.prefill_max_replicas", 8)))
+            self.decode_autoscaler = decode_autoscaler or Autoscaler(
+                min_replicas=int(cfg.get(
+                    "zoo.serving.fleet.decode_min_replicas", 1)),
+                max_replicas=int(cfg.get(
+                    "zoo.serving.fleet.decode_max_replicas", 8)))
+        else:
+            self.prefill_autoscaler = prefill_autoscaler
+            self.decode_autoscaler = decode_autoscaler
+        # router-health broker probe cache (one PING per interval)
+        self._broker_probe_ok = True
+        self._broker_probe_at = 0.0
         self.spawn_backend = spawn_backend or make_spawn_backend()
         self.reprobe_base_s = float(
             cfg.get("zoo.serving.fleet.reprobe_base_s", 0.05))
@@ -636,6 +727,7 @@ class FleetController:
         self._last_health = 0.0
         self._last_shed_total = 0.0
         self._last_high_shed_total = 0.0
+        self._last_pool_shed: Dict[str, float] = {}
         self._slo_breached = False  # edge-detects the slo_breach event
         self.broker: Optional[RedisFrontend] = None
         self.router: Optional[FleetRouter] = None
@@ -649,18 +741,59 @@ class FleetController:
     # --------------------------------------------------------- lifecycle --
     @property
     def broker_address(self) -> str:
+        # replicas connect to the ADVERTISED host (bind_host may be
+        # 0.0.0.0, which is a bind target, not a destination)
+        host = self.advertise_host or self.host
         if self.broker is None:
             # not started (manifest rendering, tests): the configured
             # endpoint, not a live socket
-            return f"{self.host}:{self._broker_port}"
-        return f"{self.broker.host}:{self.broker.port}"
+            return f"{host}:{self._broker_port}"
+        return f"{host}:{self.broker.port}"
+
+    def probe_broker_cached(self, max_age_s: float = 1.0) -> bool:
+        """Router-health broker liveness: one RESP PING per
+        ``max_age_s``, cached in between (every /healthz GET must not
+        become a broker connect). Vacuously True with no broker
+        started (router-only tests, manifest rendering): absence is
+        not unreachability."""
+        if self.broker is None:
+            return True
+        now = time.monotonic()
+        if now - self._broker_probe_at >= max_age_s:
+            from analytics_zoo_tpu.serving.redis_adapter import (
+                probe_broker)
+
+            self._broker_probe_at = now
+            self._broker_probe_ok = probe_broker(self.broker_address)
+        return self._broker_probe_ok
 
     def start(self) -> "FleetController":
         self.broker = RedisFrontend(
             host=self.host, port=self._broker_port, name=self.stream,
             result_callback=self._result_observed).serve()
-        for _ in range(self.n_target):
-            self._spawn()
+        # fail-fast misconfiguration check (ISSUE-20): the address we
+        # are about to hand every replica must answer a PING from
+        # HERE. A bad advertise_host otherwise surfaces as N replicas
+        # crash-looping on "broker unreachable".
+        from analytics_zoo_tpu.serving.redis_adapter import wait_broker
+
+        if not wait_broker(self.broker_address):
+            self.broker.stop()
+            raise RuntimeError(
+                f"fleet broker at {self.broker_address} failed its "
+                "own liveness probe -- check "
+                "zoo.serving.fleet.advertise_host / bind_host")
+        if self.disaggregated:
+            # two pools instead of one unified set; n_target tracks
+            # the combined size so wait_healthy() keeps its meaning
+            self.n_target = self.prefill_target + self.decode_target
+            for _ in range(self.prefill_target):
+                self._spawn(role="prefill")
+            for _ in range(self.decode_target):
+                self._spawn(role="decode")
+        else:
+            for _ in range(self.n_target):
+                self._spawn()
         self.router = FleetRouter(self, host=self.host,
                                   port=self._router_port).start()
         self._stop.clear()
@@ -690,7 +823,8 @@ class FleetController:
         self._update_gauges()
 
     # ----------------------------------------------------------- spawn --
-    def _replica_config(self, name: str) -> Dict[str, Any]:
+    def _replica_config(self, name: str,
+                        role: str = "unified") -> Dict[str, Any]:
         cfg = json.loads(json.dumps(self.config))  # deep copy
         cfg["data"] = {"queue": "redis", "path": self.broker_address,
                        "stream": self.stream, "group": self.group,
@@ -700,25 +834,38 @@ class FleetController:
         http["port"] = 0  # every replica picks a free port
         cfg["http"] = http
         cfg["name"] = name
+        if role != "unified":
+            gen = dict(cfg.get("generation") or {})
+            gen["role"] = role
+            gen["handoff_stream"] = self.handoff_stream
+            cfg["generation"] = gen
         return cfg
 
-    def _spawn(self, name: Optional[str] = None) -> Replica:
+    def _spawn(self, name: Optional[str] = None,
+               role: str = "unified") -> Replica:
         import yaml
 
         with self._lock:
             if name is None:
-                name = f"r{self._next_idx}"
+                prefix = {"prefill": "p", "decode": "d"}.get(role, "r")
+                name = f"{prefix}{self._next_idx}"
                 self._next_idx += 1
+            elif name in self._replicas:
+                # respawn under an existing consumer name: the pool
+                # role rides along (the reclaim story depends on the
+                # reborn consumer re-attaching to the same stream)
+                role = self._replicas[name].role
         config_path = os.path.join(self.work_dir, f"{name}.yaml")
         ready_file = os.path.join(self.work_dir, f"{name}.ready.json")
         log_path = os.path.join(self.work_dir, f"{name}.log")
         with open(config_path, "w") as f:
-            yaml.safe_dump(self._replica_config(name), f)
+            yaml.safe_dump(self._replica_config(name, role), f)
         try:
             os.unlink(ready_file)  # a stale address must never route
         except FileNotFoundError:
             pass
-        rep = Replica(name, config_path, ready_file, log_path)
+        rep = Replica(name, config_path, ready_file, log_path,
+                      role=role)
         rep.proc = self.spawn_backend.spawn(
             name,
             [sys.executable, "-m", "analytics_zoo_tpu.serving.launcher",
@@ -895,10 +1042,12 @@ class FleetController:
             self._update_gauges()
 
     # --------------------------------------------------------- routing --
-    def pick_replica(self, exclude=()) -> Optional[Replica]:
+    def pick_replica(self, exclude=(),
+                     role: Optional[str] = None) -> Optional[Replica]:
         with self._lock:
             candidates = [r for r in self._replicas.values()
-                          if r.routable() and r.name not in exclude]
+                          if r.routable() and r.name not in exclude
+                          and (role is None or r.role == role)]
             if not candidates:
                 return None
             self._rr += 1
@@ -952,6 +1101,22 @@ class FleetController:
             return None
         self.chaos_kills += 1
         return rep.name
+
+    def kill_one(self, role: str, reason: str = "drill"
+                 ) -> Optional[str]:
+        """SIGKILL the lowest-named live replica of one pool -- the
+        disaggregated soak's deterministic per-pool fault (chaos_kill
+        is seeded-random across pools)."""
+        with self._lock:
+            live = sorted(
+                (r for r in self._replicas.values()
+                 if r.role == role and r.proc is not None
+                 and r.proc.poll() is None and r.state == "up"),
+                key=lambda r: r.name)
+        for rep in live:
+            if self.kill_replica(rep.name, reason=reason):
+                return rep.name
+        return None
 
     def _identity_matches(self, rep: Replica) -> bool:
         """Recycled-identity guard, delegated to the spawn backend
@@ -1130,6 +1295,10 @@ class FleetController:
         autoscaler's bounds when one is attached). Shrinking drains:
         the victims finish in-flight work before exiting, and their
         un-started claims reclaim to survivors."""
+        if self.disaggregated:
+            raise ValueError(
+                "scale_to on a disaggregated fleet would mix pools; "
+                "use scale_pool('prefill'|'decode', n)")
         if self.autoscaler is not None:
             n = max(self.autoscaler.min_replicas,
                     min(self.autoscaler.max_replicas, n))
@@ -1181,7 +1350,60 @@ class FleetController:
             self._replicas.pop(rep.name, None)
         self._update_gauges()
 
+    def scale_pool(self, role: str, n: int,
+                   reason: str = "manual") -> int:
+        """Grow or shrink ONE pool of a disaggregated fleet to ``n``
+        replicas (clamped to that pool's autoscaler bounds when
+        attached). Shrinking drains newest-first, like scale_to --
+        and a draining decode victim re-hands its live streams to a
+        pool survivor before it exits."""
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"scale_pool role must be prefill | "
+                             f"decode, not {role!r}")
+        scaler = (self.prefill_autoscaler if role == "prefill"
+                  else self.decode_autoscaler)
+        if scaler is not None:
+            n = max(scaler.min_replicas, min(scaler.max_replicas, n))
+        n = max(1, int(n))
+        with self._lock:
+            current = {name: rep
+                       for name, rep in self._replicas.items()
+                       if rep.role == role and rep.state != "stopped"}
+        delta = n - len(current)
+        if delta == 0:
+            return 0
+        direction = "up" if delta > 0 else "down"
+        emit_event("fleet_scale", "serving", direction=direction,
+                   n_from=len(current), n_to=n,
+                   reason=f"{reason}:{role}")
+        _M_SCALE.labels(direction=direction).inc()
+        logger.info("scaling %s pool %s: %d -> %d replicas (%s)",
+                    role, direction, len(current), n, reason)
+        if delta > 0:
+            for _ in range(delta):
+                self._spawn(role=role)
+        else:
+            victims = sorted(current.values(),
+                             key=lambda r: r.started_at)[delta:]
+            for rep in victims:
+                rep.quiesced = True
+                rep.state = "stopping"
+                threading.Thread(
+                    target=self._drain_victim, args=(rep,),
+                    daemon=True,
+                    name=f"fleet-drain-{rep.name}").start()
+        if role == "prefill":
+            self.prefill_target = n
+        else:
+            self.decode_target = n
+        self.n_target = self.prefill_target + self.decode_target
+        self._update_gauges()
+        return delta
+
     def _autoscale_tick(self) -> None:
+        if self.disaggregated:
+            self._autoscale_pools_tick()
+            return
         backlog = self.broker.store.backlog(self.stream, self.group)
         sample = self._sample_replicas()
         shed_rate = max(0.0, sample["shed_total"]
@@ -1214,7 +1436,49 @@ class FleetController:
             self.scale_to(states["total"] + decision,
                           reason="autoscale")
 
-    def _sample_replicas(self) -> Dict[str, Any]:
+    def _autoscale_pools_tick(self) -> None:
+        """Disaggregated scaling: each pool decides off ITS demand
+        signal. Prefill eats the generation request stream, so its
+        backlog + admission-side latency (predict p99 / ttft where a
+        prefill replica observes it) drive that pool; decode eats the
+        handoff stream, so ITS backlog + inter-token p99 (the decode
+        pool is where token pacing lives) drive the other. SLO
+        attainment samples ride the same decide() machinery --
+        streaks, cooldown, dead band -- per pool."""
+        gen_backlog = self.broker.store.backlog(self.gen_stream,
+                                                self.group)
+        handoff_backlog = self.broker.store.backlog(
+            self.handoff_stream, f"{self.group}_decode")
+        for role, scaler, backlog in (
+                ("prefill", self.prefill_autoscaler, gen_backlog),
+                ("decode", self.decode_autoscaler, handoff_backlog)):
+            if scaler is None:
+                continue
+            sample = self._sample_replicas(role=role)
+            key = f"{role}_shed"
+            shed_rate = max(0.0, sample["shed_total"]
+                            - self._last_pool_shed.get(key, 0.0))
+            high_rate = max(0.0, sample["high_shed_total"]
+                            - self._last_pool_shed.get(
+                                key + "_high", 0.0))
+            self._last_pool_shed[key] = sample["shed_total"]
+            self._last_pool_shed[key + "_high"] = (
+                sample["high_shed_total"])
+            with self._lock:
+                n = sum(1 for r in self._replicas.values()
+                        if r.role == role and r.state != "stopped")
+            decision = scaler.decide(
+                n, backlog, shed_rate=shed_rate,
+                p99_ms=sample["p99_ms"],
+                ttft_p99_ms=sample["ttft_p99_ms"],
+                inter_token_p99_ms=sample["inter_token_p99_ms"],
+                high_shed_rate=high_rate)
+            if decision:
+                self.scale_pool(role, n + decision,
+                                reason="autoscale")
+
+    def _sample_replicas(self,
+                         role: Optional[str] = None) -> Dict[str, Any]:
         """Fleet-wide load/SLO sample scraped from replica
         /metrics.json endpoints -- best-effort: an unreachable replica
         contributes nothing (its health probe is the loud signal).
@@ -1228,7 +1492,8 @@ class FleetController:
         high_label = f"class={PRIORITY_CLASSES[0]}"
         with self._lock:
             reps = [r for r in self._replicas.values()
-                    if r.address and r.state == "up"]
+                    if r.address and r.state == "up"
+                    and (role is None or r.role == role)]
         for rep in reps:
             try:
                 with urllib.request.urlopen(
@@ -1273,7 +1538,7 @@ class FleetController:
         with self._lock:
             reps = {name: {"state": r.state, "healthy": r.healthy,
                            "quiesced": r.quiesced, "pid": r.pid,
-                           "address": r.address,
+                           "address": r.address, "role": r.role,
                            "restarts": r.restarts}
                     for name, r in sorted(self._replicas.items())}
         out = {
@@ -1285,6 +1550,26 @@ class FleetController:
                                                   self.group)
                         if self.broker is not None else 0),
         }
+        if self.disaggregated:
+            pools: Dict[str, Any] = {}
+            for pool_role, target, scaler in (
+                    ("prefill", self.prefill_target,
+                     self.prefill_autoscaler),
+                    ("decode", self.decode_target,
+                     self.decode_autoscaler)):
+                info = {
+                    "target": target,
+                    "healthy": sum(
+                        1 for r in reps.values()
+                        if r["role"] == pool_role and r["healthy"]),
+                }
+                if scaler is not None:
+                    info["autoscaler"] = scaler.stats()
+                pools[pool_role] = info
+            out["pools"] = pools
+            if self.broker is not None:
+                out["handoff_backlog"] = self.broker.store.backlog(
+                    self.handoff_stream, f"{self.group}_decode")
         if self.autoscaler is not None:
             out["autoscaler"] = self.autoscaler.stats()
         if self.min_healthy_during_restart is not None:

@@ -331,6 +331,51 @@ class DecodeEngine:
         self.cache.release(slot)
         self._update_gauges()
 
+    # ------------------------------------------------------- handoff --
+    # ISSUE-20: prefill/decode disaggregation. A prefill engine
+    # exports a slot's full decode state -- page-aligned KV snapshot
+    # plus the host slot registers (next input token, write position)
+    # -- and a decode engine on another replica imports it and keeps
+    # stepping bit-identically. Sampling is greedy argmax, so the slot
+    # carries no sampler RNG; ``rng`` stays in the snapshot as an
+    # explicit None so a future stochastic sampler extends the format
+    # instead of forking it (replay determinism is the exactly-once
+    # contract's foundation).
+
+    def export_slot(self, slot: int) -> Dict[str, Any]:
+        """Serialize an active slot for handoff. The slot stays active
+        here -- the caller releases it once the handoff is safely
+        published (or keeps decoding if publication failed)."""
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        snap = self.cache.export_pages(slot)
+        snap["next_token"] = int(self._tokens[slot])
+        snap["position"] = int(self._positions[slot])
+        snap["rng"] = None  # greedy decode: no sampler state
+        return snap
+
+    def import_slot(self, snapshot: Dict[str, Any]) -> int:
+        """Re-admit a handed-off stream: claims a slot via
+        :meth:`PagedKVCache.import_pages` (raising
+        :class:`CacheOverflow` on exhaustion -- the caller maps it to
+        the structured ``generation_overflow`` refusal), restores the
+        slot registers, and joins the running batch. On success the
+        CALLER owns the slot and owes :meth:`release` on every path,
+        exactly as for :meth:`admit`."""
+        slot = self.cache.import_pages(snapshot)  # CacheOverflow
+        try:
+            self._tokens[slot] = int(snapshot["next_token"])
+            self._positions[slot] = int(snapshot["position"])
+            self._active.add(slot)
+            self._update_gauges()
+        except BaseException:
+            # a malformed register (non-int next_token) must not
+            # strand the pages import_pages just claimed
+            self.cache.release(slot)
+            self._active.discard(slot)
+            raise
+        return slot
+
     def _update_gauges(self) -> None:
         _M_OCC.set(len(self._active))
         _M_KV.set(self.cache.utilization())

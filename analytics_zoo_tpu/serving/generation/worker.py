@@ -54,7 +54,9 @@ from analytics_zoo_tpu.serving.generation.batcher import (
 from analytics_zoo_tpu.serving.protocol import (
     DEADLINE_PREFIX, ERROR_KEY, GENERATION_PREFIX, INVALID_PREFIX,
     STREAM_KEY, priority_index, priority_name)
-from analytics_zoo_tpu.serving.queues import _decode_generation, _encode
+from analytics_zoo_tpu.serving.queues import (
+    _decode_generation, _decode_handoff, _discard_handoff, _encode,
+    _encode_handoff)
 from analytics_zoo_tpu.serving.timer import Timer
 
 logger = get_logger(__name__)
@@ -66,6 +68,8 @@ ZOOLINT_REPLY_OBLIGATED = (
     "GenerationWorker._admit_blob",
     "GenerationWorker._finish_stream",
     "GenerationWorker._abort_stream",
+    "GenerationWorker._handoff_slot",
+    "GenerationWorker._import_blob",
 )
 
 _REG = get_registry()
@@ -92,6 +96,15 @@ _M_LATENCY = _REG.histogram(
     "(the SLO autoscaler's zoo.serving.slo.ttft_ms / inter_token_ms "
     "inputs)",
     labelnames=("stage",))
+_M_HANDOFF = _REG.counter(
+    "zoo_generation_handoff_total",
+    "Prefill->decode stream handoffs by stage: export (prefill "
+    "published a stream), import (decode restored one from its KV "
+    "snapshot), regen (decode re-prefilled deterministically because "
+    "the snapshot was dropped), moved (a draining decode replica "
+    "re-published a live stream), refused (import hit cache "
+    "exhaustion -> generation_overflow)",
+    labelnames=("stage",))
 
 
 class _GenStream:
@@ -99,10 +112,10 @@ class _GenStream:
 
     __slots__ = ("uri", "reply", "trace", "deadline", "eos",
                  "max_tokens", "priority", "produced", "pending",
-                 "seq", "admitted_at", "last_token_at")
+                 "seq", "admitted_at", "last_token_at", "prompt")
 
     def __init__(self, uri, reply, trace, deadline, eos, max_tokens,
-                 priority=None):
+                 priority=None, prompt=None):
         self.uri = uri
         self.reply = reply
         self.trace = trace
@@ -115,6 +128,10 @@ class _GenStream:
         self.seq = 0           # next chunk sequence number
         self.admitted_at = time.monotonic()
         self.last_token_at: Optional[float] = None
+        # original prompt tokens -- a decode-role worker keeps them so
+        # a drain-time re-handoff stays regenerable downstream even
+        # when the KV snapshot must be dropped (ISSUE-20)
+        self.prompt = prompt
 
 
 class GenerationWorker:
@@ -132,16 +149,41 @@ class GenerationWorker:
       stream_chunk_tokens: tokens per data chunk (None reads
         ``zoo.generation.stream_chunk_tokens``; 1 = stream every
         token as it exists -- lowest TTFT-to-client, most chunks).
+      role: disaggregated pool role (ISSUE-20). "unified" (default)
+        admits AND decodes, the historical behavior. "prefill" admits
+        + prefills, then exports the slot's KV pages and publishes the
+        stream to ``handoff_queue`` (the broker's handoff stream) --
+        it never decodes. "decode" consumes handoff blobs from
+        ``input_queue``, imports the snapshot (or deterministically
+        re-prefills when it was dropped) and streams tokens; on drain
+        it re-publishes live streams to ``handoff_queue`` so a
+        survivor continues them.
+      handoff_queue: producer to the handoff stream (required for
+        "prefill", used for drain re-handoff by "decode").
     """
 
     def __init__(self, engine, input_queue, output_queue,
                  max_tokens: Optional[int] = None,
                  eos: Optional[int] = None,
-                 stream_chunk_tokens: Optional[int] = None):
+                 stream_chunk_tokens: Optional[int] = None,
+                 role: str = "unified",
+                 handoff_queue=None):
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"unknown generation role {role!r}: expected "
+                "unified | prefill | decode")
         cfg = get_config()
         self.engine = engine
+        self.role = role
         self._in = getattr(input_queue, "queue", input_queue)
         self._out_q = output_queue
+        self._handoff_out = (getattr(handoff_queue, "queue",
+                                     handoff_queue)
+                             if handoff_queue is not None else None)
+        if role == "prefill" and self._handoff_out is None:
+            raise ValueError("prefill role needs a handoff_queue")
+        self.handoff_max_bytes = int(cfg.get(
+            "zoo.serving.fleet.handoff_max_bytes", 8388608))
         self.batcher = ContinuousBatcher(self._in)
         self.default_max_tokens = int(
             cfg.get("zoo.generation.max_tokens", 64)
@@ -162,6 +204,7 @@ class GenerationWorker:
             cfg.get("zoo.serving.priority.default_class",
                     "interactive")) or 0
         self._class_served: Dict[str, int] = {}
+        self._handoff_counts: Dict[str, int] = {}
         # supervision / fleet seams (the ServingWorker contract): the
         # Supervisor reads heartbeat/_thread/_stop/_drain and clears
         # _inflight on restart; consumer-group backends expose
@@ -194,6 +237,13 @@ class GenerationWorker:
         while not stop_ev.is_set():
             self.heartbeat = time.monotonic()
             draining = drain_ev.is_set()
+            if (draining and self.role == "decode" and self._streams
+                    and self._handoff_out is not None):
+                # drain moves in-flight decode streams (ISSUE-20):
+                # re-publish each live stream's KV snapshot + replay
+                # state so a surviving decode replica continues it;
+                # streams the publish could not move finish here
+                total += self._rehandoff_streams()
             if not draining:
                 free = self.engine.free_slots()
                 if free > 0:
@@ -201,7 +251,9 @@ class GenerationWorker:
                     blobs = self.batcher.poll(
                         free, wait_timeout=idle_wait, idle=idle)
                     for blob in blobs:
-                        total += self._admit_blob(blob)
+                        total += (self._import_blob(blob)
+                                  if self.role == "decode"
+                                  else self._admit_blob(blob))
             if not self._streams:
                 if draining:
                     break
@@ -277,6 +329,7 @@ class GenerationWorker:
             return 1
         t0 = time.perf_counter()
         try:
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
             slot, tok0 = self.engine.admit(prompt, max_toks)
         except ValueError as e:
             # malformed CLIENT content past the frontend's shape
@@ -304,6 +357,13 @@ class GenerationWorker:
                              uri, e)
             self._push_error(uri, reply, str(e))
             return 1
+        if self.role == "prefill":
+            # prefill pool (ISSUE-20): this worker's part of the
+            # stream ends at the handoff publish -- no stream-table
+            # entry, no decode steps
+            return self._handoff_slot(
+                slot, uri, prompt, tok0, reply, trace, deadline,
+                eos, max_toks, priority)
         try:
             if trace:
                 get_tracer().add_span("gen_prefill", trace, t0,
@@ -312,7 +372,8 @@ class GenerationWorker:
             stream = _GenStream(
                 uri, reply, trace, deadline, eos, max_toks,
                 priority=(self._default_priority
-                          if priority is None else priority))
+                          if priority is None else priority),
+                prompt=prompt)
             self._streams[slot] = stream
             cls = priority_name(stream.priority)
             self._class_served[cls] = (
@@ -329,6 +390,225 @@ class GenerationWorker:
                    bucket=next(b for b in self.engine.ladder
                                if b >= np.asarray(prompt).size))
         return self._accept_token(slot, stream, tok0)
+
+    # ------------------------------------------------------- handoff --
+    def _handoff_slot(self, slot: int, uri: str, prompt: np.ndarray,
+                      tok0: int, reply, trace, deadline, eos,
+                      max_toks: int, priority) -> int:
+        """Prefill role: export the freshly prefilled slot and publish
+        the stream to the decode pool; the slot frees here either way
+        (on a failed publish the client gets a retryable structured
+        refusal -- the stream has no owner to decode it)."""
+        snap = None
+        try:
+            snap = self.engine.export_slot(slot)
+            state = {"next_token": int(tok0),
+                     "position": int(snap["position"]),
+                     "produced": 0, "seq": 0, "emitted": 0}
+            blob = _encode_handoff(
+                uri, prompt, state, snap, reply_to=reply,
+                trace_id=trace, deadline=deadline,
+                max_tokens=max_toks, eos=eos, priority=priority,
+                max_bytes=self.handoff_max_bytes)
+        except Exception as e:
+            logger.exception("handoff export failed for %s: %s",
+                             uri, e)
+            _discard_handoff(snap)
+            self.engine.release(slot)
+            self._push_error(uri, reply, str(e))
+            return 1
+        self.engine.release(slot)
+        ok = self._handoff_out.put(blob)
+        if not ok:
+            self._push_error(
+                uri, reply,
+                f"{GENERATION_PREFIX}: handoff stream full")
+            return 1
+        self._count_handoff("export")
+        # "ttft" on a prefill replica = admission to handoff publish
+        # (prefill + export + publish): the prefill pool's
+        # SLO-attainment signal -- the client-visible first token
+        # lands after the decode side imports
+        emit_event("kv_handoff", "generation", uri=uri, slot=slot,
+                   prompt_len=int(prompt.size),
+                   inline_kv=int(snap["kv"].nbytes
+                                 <= self.handoff_max_bytes
+                                 or not self.handoff_max_bytes))
+        self._settle(uri)
+        self.served += 1
+        return 1
+
+    def _import_blob(self, blob: bytes) -> int:
+        """Decode role: restore one handed-off stream at a step
+        boundary -- import its KV snapshot, or deterministically
+        re-prefill from the prompt when the snapshot was dropped (or
+        belonged to a dead pool geometry). Returns terminal replies
+        pushed, exactly like :meth:`_admit_blob`."""
+        chaos_point("decode")
+        try:
+            (uri, handoff, reply, trace, deadline, max_toks,
+             eos, priority) = _decode_handoff(blob)
+        except Exception as e:
+            logger.exception(
+                "generation: undecodable handoff dropped: %s", e)
+            # intentional drop: no uri/reply channel to answer on
+            return 0  # zoolint: disable=reply-missing-on-path
+        if self.ledger is not None:
+            self.ledger.record(uri, blob)
+        if deadline is not None and time.time() > deadline:
+            self._push_error(
+                uri, reply,
+                f"{DEADLINE_PREFIX}: stream missed its deadline "
+                f"after {int(handoff['produced'])} tokens")
+            return 1
+        if max_toks is None:
+            max_toks = self.default_max_tokens
+        max_toks = max(1, int(max_toks))
+        if eos is None:
+            eos = self.default_eos
+        prompt = handoff["prompt"]
+        tok0 = int(handoff["next_token"])
+        snap = handoff["snapshot"]
+        if snap is not None:
+            try:
+                slot = self.engine.import_slot(snap)
+            except CacheOverflow as e:
+                self._count_handoff("refused")
+                _M_OVERFLOW.inc()
+                self._push_error(uri, reply,
+                                 f"{GENERATION_PREFIX}: {e}")
+                return 1
+            except ValueError as e:
+                # snapshot geometry does not match this pool (mixed
+                # engine configs): fall through to deterministic
+                # regeneration rather than stranding the stream
+                logger.warning(
+                    "handoff snapshot for %s unusable (%s); "
+                    "re-prefilling", uri, e)
+            else:
+                try:
+                    get_inflight().add((uri,))
+                    stream = _GenStream(
+                        uri, reply, trace, deadline, eos, max_toks,
+                        priority=(self._default_priority
+                                  if priority is None else priority),
+                        prompt=prompt)
+                    # continue mid-stream: chunk seqs resume where
+                    # the previous owner stopped, so the client sees
+                    # one gapless sequence
+                    stream.produced = int(handoff["produced"])
+                    stream.seq = int(handoff["seq"])
+                    self._streams[slot] = stream
+                    cls = priority_name(stream.priority)
+                    self._class_served[cls] = (
+                        self._class_served.get(cls, 0) + 1)
+                except BaseException:
+                    self.engine.release(slot)
+                    raise
+                self._count_handoff("import")
+                emit_event("kv_import", "generation", uri=uri,
+                           slot=slot, regenerated=0,
+                           produced=stream.produced)
+                if not int(handoff["emitted"]):
+                    # the next-input token has not reached the client
+                    # yet (fresh prefill handoff): emit it now
+                    return self._accept_token(slot, stream, tok0)
+                return 0
+        # deterministic regeneration: the snapshot was size-dropped at
+        # publish or unusable here -- re-prefill from the prompt and
+        # replay from scratch (produced=0, seq=0): greedy decode
+        # re-emits identical chunks and consumers drop
+        # seq <= last_seen -- the exactly-once contract's
+        # determinism leg
+        try:
+            slot, tok0 = self.engine.admit(prompt, max_toks)
+        except ValueError as e:
+            logger.warning("generation: invalid handoff %s: %s",
+                           uri, e)
+            self._push_error(uri, reply, f"{INVALID_PREFIX}: {e}")
+            return 1
+        except CacheOverflow as e:
+            self._count_handoff("refused")
+            _M_OVERFLOW.inc()
+            self._push_error(uri, reply,
+                             f"{GENERATION_PREFIX}: {e}")
+            return 1
+        except Exception as e:
+            logger.exception(
+                "handoff re-prefill failed for %s: %s", uri, e)
+            self._push_error(uri, reply, str(e))
+            return 1
+        try:
+            get_inflight().add((uri,))
+            stream = _GenStream(
+                uri, reply, trace, deadline, eos, max_toks,
+                priority=(self._default_priority
+                          if priority is None else priority),
+                prompt=prompt)
+            self._streams[slot] = stream
+            cls = priority_name(stream.priority)
+            self._class_served[cls] = (
+                self._class_served.get(cls, 0) + 1)
+        except BaseException:
+            self.engine.release(slot)
+            raise
+        self._count_handoff("regen")
+        emit_event("kv_import", "generation", uri=uri, slot=slot,
+                   regenerated=1, produced=0)
+        return self._accept_token(slot, stream, tok0)
+
+    def _rehandoff_streams(self) -> int:
+        """Decode-role drain: flush pending chunks, then re-publish
+        every live stream (KV snapshot + replay state) to the handoff
+        stream for a surviving decode replica. Streams whose publish
+        failed stay live and finish here inside the drain budget.
+        Returns the number of streams moved."""
+        moved = 0
+        for slot in list(self._streams):
+            stream = self._streams.get(slot)
+            if stream is None:
+                continue
+            if stream.pending:
+                self._push_chunk(stream)
+            snap = None
+            try:
+                snap = self.engine.export_slot(slot)
+                state = {"next_token": int(snap["next_token"]),
+                         "position": int(snap["position"]),
+                         "produced": stream.produced,
+                         "seq": stream.seq,
+                         "emitted": 1}
+                blob = _encode_handoff(
+                    stream.uri,
+                    stream.prompt if stream.prompt is not None
+                    else np.zeros(0, np.int32),
+                    state, snap, reply_to=stream.reply,
+                    trace_id=stream.trace, deadline=stream.deadline,
+                    max_tokens=stream.max_tokens, eos=stream.eos,
+                    priority=stream.priority,
+                    max_bytes=self.handoff_max_bytes)
+            except Exception as e:
+                logger.warning(
+                    "drain re-handoff export for %s failed (%s); "
+                    "finishing locally", stream.uri, e)
+                _discard_handoff(snap)
+                continue
+            if not self._handoff_out.put(blob):
+                logger.warning(
+                    "handoff stream full: stream %s finishes locally",
+                    stream.uri)
+                continue
+            self._count_handoff("moved")
+            emit_event("kv_handoff", "generation", uri=stream.uri,
+                       slot=slot, prompt_len=int(
+                           stream.prompt.size
+                           if stream.prompt is not None else 0),
+                       moved=1)
+            self._streams.pop(slot, None)
+            self.engine.release(slot)
+            self._settle(stream.uri)
+            moved += 1
+        return moved
 
     # ------------------------------------------------------ stepping --
     def _finalize_results(self, results) -> int:
@@ -463,6 +743,11 @@ class GenerationWorker:
             except Exception as e:
                 logger.warning("input ack for %s failed: %s", uri, e)
 
+    def _count_handoff(self, stage: str) -> None:
+        _M_HANDOFF.labels(stage=stage).inc()
+        self._handoff_counts[stage] = (
+            self._handoff_counts.get(stage, 0) + 1)
+
     def _reply_backend(self, reply_to: Optional[str]):
         default = getattr(self._out_q, "queue", self._out_q)
         if not reply_to:
@@ -536,6 +821,7 @@ class GenerationWorker:
     def metrics(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
             "served": self.served,
+            "role": self.role,
             "streams_active": len(self._streams),
             "engine": self.engine.stats(),
             "batcher": self.batcher.stats(),
@@ -546,6 +832,10 @@ class GenerationWorker:
             # etc.) -- the fleet's SLO sampler scrapes these
             "latency": self._lat.summary(),
             "class_served": dict(self._class_served),
+            # per-stage handoff counts (mirrors the labeled
+            # zoo_generation_handoff_total counter, readable per
+            # worker without scraping the registry)
+            "handoffs": dict(self._handoff_counts),
         }
         try:
             out["queue_depth"] = len(self._in)

@@ -194,13 +194,20 @@ class HttpFrontend:
         FrontEndApp timeout settings).
     """
 
-    def __init__(self, input_queue, output_queue, host: str = "127.0.0.1",
+    def __init__(self, input_queue, output_queue,
+                 host: Optional[str] = None,
                  port: int = 0, worker=None,
                  request_timeout: float = 10.0,
                  timer: Optional[Timer] = None,
                  certfile: Optional[str] = None,
                  keyfile: Optional[str] = None,
                  gen_queue=None, gen_worker=None):
+        if host is None:
+            # cross-host fleets bind 0.0.0.0 via
+            # zoo.serving.fleet.bind_host (ISSUE-20); loopback stays
+            # the default
+            host = str(get_config().get(
+                "zoo.serving.fleet.bind_host", "127.0.0.1"))
         self._in = input_queue
         self.router = _ResultRouter(output_queue)
         self.worker = worker

@@ -62,6 +62,15 @@ Config schema (defaults in parentheses)::
       max_tokens: null                   # default new-token budget
       eos: null                          # default stop token id
       stream_chunk_tokens: null          # tokens per streamed chunk
+      role: unified                      # unified | prefill | decode
+                                         #   (ISSUE-20): prefill admits
+                                         #   + prefills, hands streams
+                                         #   to the decode pool over
+                                         #   the broker handoff stream;
+                                         #   decode consumes ONLY that
+                                         #   stream. Non-unified roles
+                                         #   need data.queue redis://
+      handoff_stream: generation_handoff_stream
 
 ``queue: tcp://...`` points every host's worker at one TcpQueueServer
 broker -- the cross-host data plane (the reference's Redis role): run N
@@ -318,6 +327,18 @@ def launch(config: Dict[str, Any], model: Any = None) -> ServingApp:
         # a dead replica's claims are reclaimable
         group = str(data.get("group", "serving"))
         consumer = str(data.get("consumer") or f"replica-{os.getpid()}")
+        # remote replicas (ISSUE-20): the broker may live on another
+        # host and may still be binding when the controller spawns us.
+        # Probe it with capped backoff BEFORE building queues -- a
+        # replica that cannot reach its data plane should die loudly
+        # (controller sees the exit, backs off) rather than wedge in
+        # a connect loop that looks like a slow start.
+        from analytics_zoo_tpu.serving.redis_adapter import wait_broker
+
+        if not wait_broker(queue_kind[len("redis://"):]):
+            raise RuntimeError(
+                f"fleet broker unreachable at {queue_kind} (see "
+                "broker_unreachable event); refusing to start")
         in_q = InputQueue(backend=queue_kind,
                           name=str(data.get("stream", "serving_stream")),
                           group=group, consumer=consumer)
@@ -431,15 +452,49 @@ def launch(config: Dict[str, Any], model: Any = None) -> ServingApp:
                 GenerationWorker)
 
             gen_stream = str(gen_cfg.get("stream", "generation_stream"))
+            # disaggregated pools (ISSUE-20): a prefill replica admits
+            # + prefills and hands each stream to the decode pool over
+            # the broker's handoff stream; a decode replica consumes
+            # ONLY that stream. The handoff stream is consumer-grouped
+            # like the request stream, so a SIGKILLed decode replica's
+            # unfinished handoffs are reclaimed by survivors.
+            gen_role = str(gen_cfg.get("role", "unified"))
+            handoff_stream = str(gen_cfg.get(
+                "handoff_stream", "generation_handoff_stream"))
+            handoff_out = None
+            if gen_role != "unified" and not (
+                    isinstance(queue_kind, str)
+                    and queue_kind.startswith("redis://")):
+                raise ValueError(
+                    f"generation.role {gen_role!r} needs data.queue "
+                    "redis:// -- the prefill->decode handoff stream "
+                    "lives on the fleet broker")
             if isinstance(queue_kind, str) and (
                     queue_kind.startswith("tcp://")
                     or queue_kind.startswith("redis://")):
                 if queue_kind.startswith("redis://"):
-                    gen_in = InputQueue(
-                        backend=queue_kind, name=gen_stream,
-                        group=str(data.get("group", "serving")),
-                        consumer=str(data.get("consumer")
-                                     or f"replica-{os.getpid()}"))
+                    gen_group = str(data.get("group", "serving"))
+                    gen_consumer = str(data.get("consumer")
+                                       or f"replica-{os.getpid()}")
+                    if gen_role == "decode":
+                        # the decode pool shards the HANDOFF stream
+                        # under its own group (prefill replicas share
+                        # the request-stream group); a dead member's
+                        # pending handoffs ride the PEL to a survivor
+                        gen_in = InputQueue(
+                            backend=queue_kind, name=handoff_stream,
+                            group=f"{gen_group}_decode",
+                            consumer=gen_consumer)
+                    else:
+                        gen_in = InputQueue(
+                            backend=queue_kind, name=gen_stream,
+                            group=gen_group, consumer=gen_consumer)
+                    if gen_role in ("prefill", "decode"):
+                        # prefill PUBLISHES handoffs; decode publishes
+                        # too, at drain time, to move its live streams
+                        # to a pool survivor before exiting
+                        handoff_out = OutputQueue(
+                            backend=queue_kind, name=handoff_stream)
                 else:
                     gen_in = InputQueue(backend=queue_kind,
                                         name=gen_stream)
@@ -468,7 +523,8 @@ def launch(config: Dict[str, Any], model: Any = None) -> ServingApp:
                 max_tokens=gen_cfg.get("max_tokens"),
                 eos=gen_cfg.get("eos"),
                 stream_chunk_tokens=gen_cfg.get(
-                    "stream_chunk_tokens")).start()
+                    "stream_chunk_tokens"),
+                role=gen_role, handoff_queue=handoff_out).start()
             if supervise:
                 from analytics_zoo_tpu.serving.resilience import (
                     Supervisor)
@@ -483,7 +539,10 @@ def launch(config: Dict[str, Any], model: Any = None) -> ServingApp:
             frontend = HttpFrontend(
                 in_q,
                 out_q if frontend_out_q is None else frontend_out_q,
-                host=http.get("host", "127.0.0.1"),
+                # no YAML host -> zoo.serving.fleet.bind_host (the
+                # frontend's config-driven default; loopback unless
+                # the deployment opts into a routable bind)
+                host=http.get("host"),
                 port=port, worker=worker,
                 certfile=http.get("certfile"),
                 keyfile=http.get("keyfile"),
@@ -508,7 +567,7 @@ def launch(config: Dict[str, Any], model: Any = None) -> ServingApp:
                 RedisFrontend)
 
             redis_fe = RedisFrontend(
-                in_q, out_q, host=redis_cfg.get("host", "127.0.0.1"),
+                in_q, out_q, host=redis_cfg.get("host"),
                 port=int(redis_cfg.get("port", 6379)),
                 name=redis_cfg.get("stream", "serving_stream")).serve()
         # config-gated rollup logger (zoo.obs.report.interval seconds;
@@ -571,9 +630,19 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     app = launch_from_yaml(args.config)
     if args.ready_file:
+        address = app.address
+        # cross-host fleets (ISSUE-20): the frontend binds
+        # zoo.serving.fleet.bind_host (often 0.0.0.0 in a container),
+        # but the CONTROLLER must route to an address reachable from
+        # its host -- zoo.serving.fleet.advertise_host, when set,
+        # replaces the bound host in the readiness address
+        adv = str(get_config().get(
+            "zoo.serving.fleet.advertise_host", "") or "")
+        if adv and address and ":" in address:
+            address = f"{adv}:{address.rsplit(':', 1)[1]}"
         tmp = args.ready_file + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"pid": os.getpid(), "address": app.address,
+            json.dump({"pid": os.getpid(), "address": address,
                        "started_at": time.time()}, f)
         os.replace(tmp, args.ready_file)  # atomic: never half-read
     stop = threading.Event()

@@ -82,13 +82,23 @@ TENANT_KEY = "__tenant__"
 # request keeps its class exactly like __tenant__ keeps its lane.
 # Absent -> zoo.serving.priority.default_class.
 PRIORITY_KEY = "__priority__"
+# disaggregated prefill/decode pools (ISSUE-20): a blob carrying
+# HANDOFF_KEY is a prefill->decode stream handoff riding the broker's
+# handoff stream, NOT a client request. Its value is the handoff
+# format version (int32); the blob's tensors carry the prompt, the
+# page-aligned KV snapshot, and the slot replay state (next token,
+# position, produced count, chunk seq) so a decode replica can import
+# the stream -- or deterministically regenerate it when the snapshot
+# was dropped -- without breaking the chunk-seq exactly-once contract.
+HANDOFF_KEY = "__handoff__"
 
 # request-side out-of-band keys the decoder strips from tensor dicts
 # (ERROR_KEY/STREAM_KEY are reply-side only: model outputs named
 # "error" stay usable, and an error reply is recognised by ERROR_KEY's
 # presence, a stream chunk by STREAM_KEY's)
 WIRE_KEYS = (URI_KEY, REPLY_KEY, TRACE_KEY, DEADLINE_KEY,
-             MAX_TOKENS_KEY, EOS_KEY, TENANT_KEY, PRIORITY_KEY)
+             MAX_TOKENS_KEY, EOS_KEY, TENANT_KEY, PRIORITY_KEY,
+             HANDOFF_KEY)
 
 # ---------------------------------------------------- priority classes --
 # Index 0 is the HIGHEST class: the admission ladder sheds from the

@@ -28,8 +28,9 @@ from analytics_zoo_tpu.obs import tracing as _tracing
 from analytics_zoo_tpu.obs.metrics import get_registry as _get_registry
 from analytics_zoo_tpu.serving.admission import AdmissionController
 from analytics_zoo_tpu.serving.protocol import (
-    DEADLINE_KEY, EOS_KEY, MAX_TOKENS_KEY, PRIORITY_KEY, REPLY_KEY,
-    TENANT_KEY, TRACE_KEY, URI_KEY, WIRE_KEYS, priority_index)
+    DEADLINE_KEY, EOS_KEY, HANDOFF_KEY, MAX_TOKENS_KEY, PRIORITY_KEY,
+    REPLY_KEY, TENANT_KEY, TRACE_KEY, URI_KEY, WIRE_KEYS,
+    priority_index)
 
 # client-side data-plane counters (the queues' entry in the unified
 # registry): offered load, backpressure rejections, drained results.
@@ -257,6 +258,104 @@ def _decode_generation(blob: bytes
     tensors = {k: v for k, v in z.items() if k not in _META_KEYS}
     return (uri, tensors, reply, trace, deadline, max_tokens, eos,
             priority)
+
+
+# ------------------------------------------------- stream handoff --
+# ISSUE-20 (disaggregated prefill/decode pools): a prefill replica
+# publishes one handoff blob per admitted stream on the broker's
+# handoff stream; a decode replica imports it and continues the
+# stream. The blob carries the full replay state -- the prompt (for
+# deterministic regeneration when the KV snapshot was dropped or died
+# with its host), the page-aligned KV snapshot when it fits
+# ``max_bytes``, and the slot registers + chunk-seq counters that keep
+# re-served chunks dedupable at the client.
+
+def _encode_handoff(uri: str, prompt: np.ndarray,
+                    state: Dict[str, int],
+                    snapshot: Optional[Dict[str, Any]] = None,
+                    reply_to: Optional[str] = None,
+                    trace_id: Optional[str] = None,
+                    deadline: Optional[float] = None,
+                    max_tokens: Optional[int] = None,
+                    eos: Optional[int] = None,
+                    priority: Optional[int] = None,
+                    max_bytes: int = 0) -> bytes:
+    """Encode a prefill->decode stream handoff. ``state`` carries the
+    slot registers: ``next_token`` (the token the next decode step
+    consumes), ``position`` (its write position), ``produced`` (output
+    tokens already delivered), ``seq`` (next chunk sequence number)
+    and ``emitted`` (whether ``next_token`` already reached the
+    client). A snapshot larger than ``max_bytes`` (> 0) is dropped --
+    the importer then re-prefills deterministically from the prompt."""
+    payload: Dict[str, np.ndarray] = {
+        HANDOFF_KEY: np.asarray(1, np.int32),
+        "prompt": np.asarray(prompt, np.int32).reshape(-1),
+    }
+    for key in ("next_token", "position", "produced", "seq",
+                "emitted"):
+        payload[key] = np.asarray(int(state[key]), np.int32)
+    if snapshot is not None:
+        kv = np.asarray(snapshot["kv"])
+        if not (max_bytes and kv.nbytes > max_bytes):
+            payload["kv"] = kv
+            payload["kv_length"] = np.asarray(
+                int(snapshot["length"]), np.int32)
+            payload["kv_reserve"] = np.asarray(
+                int(snapshot["reserve"]), np.int32)
+    return _encode(uri, payload, reply_to=reply_to, trace_id=trace_id,
+                   deadline=deadline, max_tokens=max_tokens, eos=eos,
+                   priority=priority)
+
+
+def _decode_handoff(blob: bytes
+                    ) -> Tuple[str, Dict[str, Any], Optional[str],
+                               Optional[str], Optional[float],
+                               Optional[int], Optional[int],
+                               Optional[int]]:
+    """The decode replica's decode: ``(uri, handoff, reply, trace,
+    deadline, max_tokens, eos, priority)`` where ``handoff`` holds the
+    prompt, the slot-register state, and ``snapshot`` (an
+    ``import_pages``-shaped dict, or None when the KV pages were
+    dropped at publish time). Raises ValueError on a blob that is not
+    a handoff (no ``__handoff__`` marker) -- a client request on the
+    handoff stream is a routing bug, not a soft error."""
+    z = _decode_to_dict(blob)
+    if HANDOFF_KEY not in z:
+        raise ValueError("not a handoff blob (no __handoff__ marker)")
+    uri, reply, trace, deadline = _request_meta(z)
+    max_tokens = (int(z[MAX_TOKENS_KEY].reshape(()))
+                  if MAX_TOKENS_KEY in z else None)
+    eos = int(z[EOS_KEY].reshape(())) if EOS_KEY in z else None
+    priority = (int(z[PRIORITY_KEY].reshape(()))
+                if PRIORITY_KEY in z else None)
+    handoff: Dict[str, Any] = {
+        "prompt": np.asarray(z["prompt"], np.int32).reshape(-1),
+        "snapshot": None,
+    }
+    for key in ("next_token", "position", "produced", "seq",
+                "emitted"):
+        handoff[key] = int(z[key].reshape(()))
+    if "kv" in z:
+        handoff["snapshot"] = {
+            "kv": z["kv"],
+            "length": int(z["kv_length"].reshape(())),
+            "reserve": int(z["kv_reserve"].reshape(())),
+            "next_token": handoff["next_token"],
+            "position": handoff["position"],
+            "rng": None,
+        }
+    return (uri, handoff, reply, trace, deadline, max_tokens, eos,
+            priority)
+
+
+def _discard_handoff(snapshot: Optional[Dict[str, Any]]) -> None:
+    """Abandon an exported KV snapshot that will never reach the wire
+    (encode failed before publish). The pages themselves still live in
+    the engine slot the exporter holds, so dropping the copy frees
+    nothing -- this exists (and is registered as the kv-handoff
+    release verb in zoolint's lifecycle registry) so an abandonment is
+    a visible decision on the failure path, not a silent leak."""
+    return None
 
 
 class MemQueue:

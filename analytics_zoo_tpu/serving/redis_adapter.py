@@ -552,7 +552,7 @@ class RedisFrontend:
     exactly-once ledger)."""
 
     def __init__(self, input_queue=None, output_queue=None,
-                 host: str = "127.0.0.1", port: int = 6379,
+                 host: Optional[str] = None, port: int = 6379,
                  name: str = "serving_stream",
                  result_stream: str = "result_stream",
                  store: Optional[StreamStore] = None,
@@ -561,6 +561,12 @@ class RedisFrontend:
         if (input_queue is None) != (output_queue is None):
             raise ValueError("pass both queues (bridge mode) or "
                              "neither (stream mode)")
+        if host is None:
+            # cross-host fleets bind 0.0.0.0 via
+            # zoo.serving.fleet.bind_host (ISSUE-20); loopback stays
+            # the default so single-host deployments expose nothing
+            host = str(get_config().get(
+                "zoo.serving.fleet.bind_host", "127.0.0.1"))
         self._in = input_queue
         self._out = output_queue
         self.name = name
@@ -1253,3 +1259,72 @@ class RedisStreamQueue:
                 except OSError:
                     pass
                 self._sock = None
+
+
+# ------------------------------------------------- liveness probe --
+# ISSUE-20: a dead broker used to surface only as generic connection
+# errors deep inside a claim pass. probe_broker is one cheap PING
+# round trip; wait_broker retries it with capped-exponential backoff
+# and emits ONE broker_unreachable event when the budget is spent --
+# the readiness gate remote replicas and the fleet router run before
+# touching the data plane.
+
+def _split_address(address: str) -> Tuple[str, int]:
+    """``host:port`` (optionally ``redis://``/``tcp://``-prefixed)
+    -> (host, port)."""
+    addr = address
+    for prefix in ("redis://", "tcp://"):
+        if addr.startswith(prefix):
+            addr = addr[len(prefix):]
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+def probe_broker(address: str, timeout_s: float = 2.0) -> bool:
+    """One PING round trip against the stream broker; True iff it
+    answered PONG inside ``timeout_s``."""
+    host, port = _split_address(address)
+    try:
+        with socket.create_connection((host, port),
+                                      timeout=timeout_s) as sock:
+            sock.sendall(b"*1\r\n$4\r\nPING\r\n")
+            sock.settimeout(timeout_s)
+            data = sock.recv(64)
+            return data.startswith((b"+PONG", b"$4\r\nPONG"))
+    except OSError:
+        return False
+
+
+def wait_broker(address: str, retries: Optional[int] = None,
+                base_s: Optional[float] = None,
+                max_s: Optional[float] = None,
+                timeout_s: float = 2.0) -> bool:
+    """Readiness-probe the broker with capped-backoff retries
+    (``zoo.serving.fleet.broker_probe_*`` defaults). False -- after
+    emitting one structured ``broker_unreachable`` event -- when every
+    attempt failed; callers decide whether that is fatal (a launching
+    replica) or a soft degradation (a router health sweep)."""
+    cfg = get_config()
+    if retries is None:
+        retries = int(cfg.get(
+            "zoo.serving.fleet.broker_probe_retries", 6))
+    if base_s is None:
+        base_s = float(cfg.get(
+            "zoo.serving.fleet.broker_probe_base_s", 0.05))
+    if max_s is None:
+        max_s = float(cfg.get(
+            "zoo.serving.fleet.broker_probe_max_s", 2.0))
+    t0 = time.monotonic()
+    backoff = base_s
+    for attempt in range(int(retries) + 1):
+        if probe_broker(address, timeout_s=timeout_s):
+            return True
+        if attempt < int(retries):
+            time.sleep(backoff)
+            backoff = min(backoff * 2.0, max_s)
+    waited = time.monotonic() - t0
+    emit_event("broker_unreachable", "serving", address=address,
+               retries=int(retries), waited_s=round(waited, 3))
+    logger.warning("broker %s unreachable after %d probes (%.2fs)",
+                   address, int(retries) + 1, waited)
+    return False

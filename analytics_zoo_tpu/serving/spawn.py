@@ -255,9 +255,104 @@ class ManifestSpawnBackend(SpawnBackend):
                                   default_flow_style=False)
 
 
+class RemoteSpawnBackend(SpawnBackend):
+    """Drives replicas as separate containers/hosts through a
+    command-runner prefix (ISSUE-20) -- the runnable counterpart of
+    the manifests :class:`ManifestSpawnBackend` renders.
+
+    ``runner`` is an argv prefix that executes its arguments on the
+    target substrate: ``["ssh", "worker-3"]``, ``["docker", "exec",
+    "zoo-fleet"]``, or empty = run the argv directly on this host (the
+    degenerate remote target; byte-equivalent to
+    :class:`LocalSpawnBackend` modulo process-group signaling). The
+    *driver* process -- the local ``ssh``/``exec`` -- is the handle:
+    its lifetime tracks the replica's for exec-style runners, and all
+    signaling lands on its process group (``start_new_session`` makes
+    the driver the group leader), so SIGTERM drains and SIGKILL
+    hard-kills reach the replica through the same channel that
+    launched it.
+
+    Environment: with an empty runner the env dict passes straight to
+    ``Popen``. With a non-empty runner the replica runs on a DIFFERENT
+    host, so the config-bearing keys (``AZT_*`` overrides,
+    ``PYTHONPATH``, ``JAX_*``) are serialized into an ``env K=V ...``
+    command prefix instead -- the one channel guaranteed to cross any
+    exec-style runner.
+
+    Readiness stays the controller's business: the ready-file channel
+    now carries the replica's ADVERTISED ``host:port``
+    (``zoo.serving.fleet.advertise_host``), and the broker liveness
+    probe (``redis_adapter.wait_broker``) gates a remote replica's
+    launch on the broker actually being reachable across hosts."""
+
+    name = "remote"
+
+    # env keys worth shipping across an exec-style runner: config
+    # overrides + interpreter/search-path + accelerator selection
+    _ENV_FORWARD_PREFIXES = ("AZT_", "JAX_", "XLA_")
+    _ENV_FORWARD_KEYS = ("PYTHONPATH",)
+
+    def __init__(self, runner: Optional[Sequence[str]] = None):
+        if runner is None:
+            from analytics_zoo_tpu.common.config import get_config
+
+            runner = str(get_config().get(
+                "zoo.serving.fleet.remote_runner", "")).split()
+        self.runner: List[str] = list(runner)
+
+    def _forwarded_env(self, env: Dict[str, str]) -> List[str]:
+        out = []
+        for k in sorted(env):
+            if (k in self._ENV_FORWARD_KEYS
+                    or k.startswith(self._ENV_FORWARD_PREFIXES)):
+                out.append(f"{k}={env[k]}")
+        return out
+
+    def spawn(self, name: str, argv: Sequence[str], log_path: str,
+              env: Dict[str, str]) -> subprocess.Popen:
+        if self.runner:
+            command = (self.runner + ["env"]
+                       + self._forwarded_env(env) + list(argv))
+        else:
+            command = list(argv)
+        log_f = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                command, stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True, env=env)
+        finally:
+            log_f.close()
+        logger.info("remote backend launched replica %s via %s "
+                    "(driver pid %d)", name,
+                    self.runner or "direct exec", proc.pid)
+        return proc
+
+    def identity(self, handle) -> Optional[tuple]:
+        # the DRIVER's /proc identity: the recycled-pid guard protects
+        # the local process we signal, which is the only process this
+        # host can name
+        return _proc_identity(handle.pid)
+
+    def identity_matches(self, handle, identity) -> bool:
+        if identity is None or handle is None:
+            return True  # no /proc at spawn: cannot disprove
+        now = _proc_identity(handle.pid)
+        return now is None or now[0] == identity[0]
+
+    def signal(self, handle, sig: int) -> None:
+        # whole driver process group: an exec-style runner may have
+        # interposed an ``env``/shell hop between the driver and the
+        # replica -- group delivery reaches every link of that chain
+        try:
+            os.killpg(handle.pid, sig)
+        except ProcessLookupError:
+            os.kill(handle.pid, sig)
+
+
 def make_spawn_backend(name: Optional[str] = None) -> SpawnBackend:
     """Backend by name; None reads ``zoo.serving.fleet.spawn_backend``
-    (enum-validated by the config layer: local | manifest)."""
+    (enum-validated by the config layer: local | manifest |
+    remote)."""
     if name is None:
         from analytics_zoo_tpu.common.config import get_config
 
@@ -267,5 +362,8 @@ def make_spawn_backend(name: Optional[str] = None) -> SpawnBackend:
         return LocalSpawnBackend()
     if name == "manifest":
         return ManifestSpawnBackend()
+    if name == "remote":
+        return RemoteSpawnBackend()
     raise ValueError(
-        f"unknown spawn backend {name!r}: expected local | manifest")
+        f"unknown spawn backend {name!r}: expected local | manifest "
+        "| remote")

@@ -14,6 +14,10 @@
 #                                        # soak (2 replica processes, one
 #                                        # SIGKILL, rolling restart; ~2
 #                                        # min) -- the exactly-once gate --
+#                                        # plus the split-pool smoke (2
+#                                        # prefill + 2 decode replicas,
+#                                        # one SIGKILL per pool, KV-
+#                                        # handoff exactly-once gate)
 #                                        # plus the generation soak smoke
 #                                        # (60 overlapping token streams,
 #                                        # exact + exactly-once + A/B)
@@ -41,10 +45,14 @@ python -m pytest tests/test_zoolint.py tests/test_zoolint_lifecycle.py \
     tests/test_metric_names.py -q -p no:cacheprovider
 
 if [ "$SOAK" = 1 ]; then
+    echo "== slow acceptance drills (process-fleet, -m slow) =="
+    python -m pytest tests/ -q -m slow -p no:cacheprovider
     echo "== fleet chaos soak (smoke) =="
     python scripts/fleet_soak.py --smoke
     echo "== fleet overload soak (zipf smoke) =="
     python scripts/fleet_soak.py --zipf --smoke
+    echo "== disaggregated fleet soak (split-pool smoke) =="
+    python scripts/fleet_soak.py --disaggregated --smoke
     echo "== generation soak (smoke) =="
     python scripts/perf_generation.py --smoke
     echo "== automl vectorized A/B (smoke) =="

@@ -29,8 +29,21 @@ zipf-skewed tenant ids riding the uri. Pass adds:
 - the background class browns out (shed > 0) while interactive e2e
   p99 stays within ``--slo-p99-ms`` despite a mid-run replica SIGKILL.
 
+``--disaggregated`` switches to the split-pool drill (ISSUE-20): the
+fleet runs dedicated prefill and decode replica pools (paged-KV
+handoff stream between them), the zipf predict overload runs
+unchanged through the same replicas, and a generation lane streams
+token replies (chunk-``seq`` dedup client-side) while a watcher
+SIGKILLs one PREFILL and one DECODE replica mid-run. Pass adds:
+- every generation stream terminates EXACTLY once, gapless after
+  seq dedup, with the full token budget (a killed decode replica's
+  streams resume from the reclaimed KV snapshot on a survivor; a
+  killed prefill replica's claims re-prefill from scratch);
+- both role-targeted kills fired;
+- generation TTFT p99 within ``--gen-ttft-slo-ms``.
+
 Prints one JSON line (the chaos_serving.py convention) and exits 0
-only when both hold.
+only when every armed gate holds.
 """
 
 import argparse
@@ -278,9 +291,240 @@ def zipf_phase(args, fc, answered: dict, answer_times: dict,
     }
 
 
+def disagg_phase(args, fc, answered: dict, answer_times: dict,
+                 xs: np.ndarray) -> dict:
+    """Split-pool drill: the zipf predict overload runs unchanged
+    while a generation lane streams token replies through the
+    prefill -> handoff -> decode pipeline; a watcher SIGKILLs one
+    replica of EACH pool keyed on lane progress."""
+    from analytics_zoo_tpu.serving.protocol import ERROR_KEY, STREAM_KEY
+    from analytics_zoo_tpu.serving.queues import _decode, _encode
+    from analytics_zoo_tpu.serving.redis_adapter import RedisStreamQueue
+
+    n, n_tok = args.gen_streams, args.gen_tokens
+    reply_stream = "fleet_soak_gen_replies"
+    rng = np.random.RandomState(args.seed + 2)
+    classes = rng.choice(3, size=n, p=CLASS_MIX)
+    tenants = rng.choice(args.tenants, size=n,
+                         p=_zipf_probs(args.tenants, args.zipf_s))
+    prompts = [rng.randint(1, 64, size=6).astype(np.int32)
+               for _ in range(n)]
+    uris = [f"g{int(tenants[i]):03d}-{i:05d}" for i in range(n)]
+    recs: dict = {u: {"last": -1, "toks": 0, "terms": 0, "errs": 0,
+                      "dups": 0, "t_sent": None, "t_first": None,
+                      "t_done": None}
+                  for u in uris}
+    stop = threading.Event()
+    halt = threading.Event()  # predict phase over: send no new streams
+    kills: dict = {}
+    state = {"sent": 0, "done": 0}
+    lock = threading.Lock()
+
+    def consumer():
+        sub = RedisStreamQueue(fc.broker_address, stream=reply_stream,
+                               group="soak_gen", consumer="c0",
+                               autoack=True)
+        while not stop.is_set():
+            blob = sub.get(timeout=0.2)
+            if blob is None:
+                continue
+            uri, tens = _decode(blob)
+            rec = recs.get(uri)
+            if rec is None:
+                continue
+            now = time.perf_counter()
+            if ERROR_KEY in tens:
+                # structured terminal (seq -1): fails the gate below
+                rec["errs"] += 1
+                with lock:
+                    state["done"] += 1
+                continue
+            seq = int(np.asarray(tens[STREAM_KEY]).reshape(()))
+            if seq <= rec["last"]:
+                rec["dups"] += 1  # replayed chunk: deduped by seq
+                continue
+            if seq != rec["last"] + 1:
+                rec["gap"] = (rec["last"], seq)
+            rec["last"] = seq
+            if rec["t_first"] is None:
+                rec["t_first"] = now
+            if "token" in tens:
+                rec["toks"] += int(
+                    np.asarray(tens["token"]).reshape(-1).size)
+            if "finish_reason" in tens:
+                rec["terms"] += 1
+                rec["t_done"] = now
+                with lock:
+                    state["done"] += 1
+
+    def producer():
+        # bounded in-flight window: the decode pool's slot tables cap
+        # concurrency anyway (capacity-gated handoff claims), the
+        # window just keeps queue wait out of the TTFT measurement
+        prod = RedisStreamQueue(fc.broker_address,
+                                stream=fc.gen_stream)
+        for i, uri in enumerate(uris):
+            while not stop.is_set() and not halt.is_set():
+                with lock:
+                    if state["sent"] - state["done"] < args.gen_window:
+                        break
+                time.sleep(0.02)
+            if stop.is_set() or halt.is_set():
+                return
+            recs[uri]["t_sent"] = time.perf_counter()
+            while not prod.put(_encode(
+                    uri, {"tokens": prompts[i]},
+                    reply_to=reply_stream, max_tokens=n_tok,
+                    priority=int(classes[i]))):
+                time.sleep(0.01)
+            with lock:
+                state["sent"] += 1
+
+    def watcher():
+        # role-targeted faults keyed on lane progress so both land
+        # with streams in flight; absolute caps keep the thresholds
+        # early even when the lane is sized to span a long run
+        fired = set()
+        at_decode = max(2, min(n // 4, 8 * args.gen_window))
+        at_prefill = max(4, min(n // 2, 16 * args.gen_window))
+        while not stop.is_set() and len(fired) < 2:
+            with lock:
+                done = state["done"]
+            if "decode" not in fired and done >= at_decode:
+                kills["decode"] = fc.kill_one("decode", reason="soak")
+                fired.add("decode")
+            if "prefill" not in fired and done >= at_prefill:
+                kills["prefill"] = fc.kill_one("prefill",
+                                               reason="soak")
+                fired.add("prefill")
+            time.sleep(0.05)
+
+    # warm the generation plane first (prefill bucket + decode step
+    # compiles on both pools): the predict calibration burst must
+    # measure the mixed steady state, not a compile-contended window
+    # -- an undershot capacity makes the "2x" paced phase sub-capacity
+    # and the brownout ladder never sheds
+    warm = RedisStreamQueue(fc.broker_address, stream=fc.gen_stream)
+    # warmup replies ride their OWN stream: a consumer group that goes
+    # quiet pins every later entry as outstanding (the store's
+    # all-groups ack-to-trim rule), so parking soak_gen_warm on the
+    # lane's reply stream would backpressure decode publishes once the
+    # lane outgrows maxlen -- wedging the final in-flight window
+    warm_reply = reply_stream + "_warm"
+    wsub = RedisStreamQueue(fc.broker_address, stream=warm_reply,
+                            group="soak_gen_warm", consumer="w0",
+                            autoack=True)
+    n_warm = 2 * args.gen_window
+    for j in range(n_warm):
+        while not warm.put(_encode(f"warm-{j:03d}",
+                                   {"tokens": prompts[j % n]},
+                                   reply_to=warm_reply,
+                                   max_tokens=2)):
+            time.sleep(0.01)
+    wterms = 0
+    wdeadline = time.time() + 180
+    while wterms < n_warm and time.time() < wdeadline:
+        blob = wsub.get(timeout=0.2)
+        if blob is None:
+            continue
+        uri, tens = _decode(blob)
+        if uri.startswith("warm-") and ("finish_reason" in tens
+                                        or ERROR_KEY in tens):
+            wterms += 1
+
+    threads = [threading.Thread(target=f, daemon=True)
+               for f in (consumer, producer, watcher)]
+    for t in threads:
+        t.start()
+
+    # the predict overload drill runs concurrently through the same
+    # split fleet (every replica serves predict regardless of role)
+    extra = zipf_phase(args, fc, answered, answer_times, xs)
+
+    # predict phase over: halt new streams, let in-flight ones finish
+    halt.set()
+    deadline = time.time() + args.drain_timeout
+    while time.time() < deadline:
+        with lock:
+            if state["done"] >= state["sent"]:
+                break
+        time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(5.0)
+    sent = state["sent"]
+    sent_uris = [u for u in uris if recs[u]["t_sent"] is not None]
+
+    ttft = [(r["t_first"] - r["t_sent"]) * 1000.0
+            for r in recs.values()
+            if r["t_first"] is not None and r["t_sent"] is not None]
+    e2e = [(r["t_done"] - r["t_sent"]) * 1000.0
+           for r in recs.values()
+           if r["t_done"] is not None and r["t_sent"] is not None]
+    complete = sum(1 for u in sent_uris
+                   if recs[u]["terms"] == 1
+                   and recs[u]["toks"] == n_tok)
+    gaps = sum(1 for r in recs.values() if "gap" in r)
+    errs = sum(r["errs"] for r in recs.values())
+    multi = sum(1 for r in recs.values() if r["terms"] > 1)
+    replays = sum(r["dups"] for r in recs.values())
+    ttft_p99 = (round(float(np.percentile(ttft, 99)), 1)
+                if ttft else None)
+    ttft_within = (ttft_p99 is not None
+                   and ttft_p99 <= args.gen_ttft_slo_ms)
+    gen_exactly_once = (sent > 0 and complete == sent and gaps == 0
+                        and errs == 0 and multi == 0)
+    extra["mode"] = "disaggregated"
+    extra["offered_total"] = args.requests + sent
+    extra["generation"] = {
+        "streams": sent, "lane_size": n,
+        "tokens_per_stream": n_tok,
+        "complete": complete, "terminals_gt1": multi,
+        "seq_gaps": gaps, "errors": errs,
+        "replayed_chunks_deduped": replays,
+        "ttft_p99_ms": ttft_p99,
+        "e2e_p99_ms": (round(float(np.percentile(e2e, 99)), 1)
+                       if e2e else None),
+        "ttft_slo": {"target_ms": args.gen_ttft_slo_ms,
+                     "within": ttft_within},
+        "exactly_once": gen_exactly_once,
+    }
+    extra["kills"] = kills
+    extra["pools"] = fc.stats().get("pools", {})
+    # per-pool interactive-SLO attainment: every replica serves the
+    # predict plane regardless of role, so each pool's worst-replica
+    # service p99 is scored against the same interactive target (the
+    # gen-plane TTFT/inter-token sample rides along for the decode
+    # pool's SLO picture)
+    for pool_role in ("prefill", "decode"):
+        samp = fc._sample_replicas(role=pool_role)
+        p99 = samp.get("p99_ms")
+        extra["pools"].setdefault(pool_role, {})["slo"] = {
+            "interactive_p99_ms": (round(p99, 1)
+                                   if p99 is not None else None),
+            "target_ms": args.slo_p99_ms,
+            "within": p99 is not None and p99 <= args.slo_p99_ms,
+            "ttft_p99_ms": (round(samp["ttft_p99_ms"], 1)
+                            if samp.get("ttft_p99_ms") is not None
+                            else None),
+            "inter_token_p99_ms": (
+                round(samp["inter_token_p99_ms"], 1)
+                if samp.get("inter_token_p99_ms") is not None
+                else None),
+        }
+    extra["disagg_pass"] = (
+        extra.get("zipf_pass", False) and gen_exactly_once
+        and kills.get("prefill") is not None
+        and kills.get("decode") is not None and ttft_within)
+    return extra
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="offered predict requests (default 2000; "
+                         "200000 with --disaggregated -- 10x the "
+                         "FLEET_SOAK_r02 scale)")
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--spec", default=DEFAULT_SPEC,
@@ -310,11 +554,46 @@ def main():
                          "fleet capacity")
     ap.add_argument("--slo-p99-ms", type=float, default=500.0,
                     help="interactive end-to-end p99 gate (zipf mode)")
+    ap.add_argument("--disaggregated", action="store_true",
+                    help="split-pool drill: prefill/decode pools, "
+                         "zipf predict overload + generation lane, "
+                         "one SIGKILL per pool, KV-handoff "
+                         "exactly-once + TTFT SLO gates")
+    ap.add_argument("--prefill-replicas", type=int, default=2)
+    ap.add_argument("--decode-replicas", type=int, default=2)
+    ap.add_argument("--gen-streams", type=int, default=256,
+                    help="generation lane size (streams)")
+    ap.add_argument("--gen-tokens", type=int, default=8,
+                    help="new-token budget per generation stream")
+    ap.add_argument("--gen-window", type=int, default=16,
+                    help="generation lane in-flight window")
+    ap.add_argument("--gen-ttft-slo-ms", type=float, default=5000.0,
+                    help="generation time-to-first-chunk p99 gate")
     ap.add_argument("--smoke", action="store_true",
                     help="CI-sized run: 2 replicas, 120 requests, "
-                         "one kill (600 requests with --zipf)")
+                         "one kill (600 requests with --zipf / "
+                         "--disaggregated)")
     args = ap.parse_args()
-    if args.smoke:
+    if args.requests is None:
+        args.requests = 200000 if args.disaggregated else 2000
+    if args.smoke and args.disaggregated:
+        args.requests = min(args.requests, 600)
+        args.gen_streams = min(args.gen_streams, 24)
+        args.gen_tokens = min(args.gen_tokens, 6)
+        args.gen_window = min(args.gen_window, 8)
+        # same reasoning as the zipf smoke: the run is shorter than a
+        # kill-recovery window, so its p99 IS the recovery spike --
+        # the smoke asserts mechanics, the full run gates the SLOs
+        args.slo_p99_ms = max(args.slo_p99_ms, 15000.0)
+        args.gen_ttft_slo_ms = max(args.gen_ttft_slo_ms, 60000.0)
+        # smoke capacity calibration is noisy on a loaded box (the gen
+        # lane's intensity varies across a ~10 s window): shed_depth 32
+        # + 3x pacing keep the paced phase over true capacity -- and
+        # the brownout ladder exercised -- even when calibration
+        # undershoots by ~2x
+        args.shed_depth = min(args.shed_depth or 32, 32)
+        args.overload = max(args.overload, 3.0)
+    elif args.smoke:
         args.replicas = min(args.replicas, 2)
         if args.zipf:
             args.requests = min(args.requests, 600)
@@ -345,12 +624,18 @@ def main():
             args.spec = "kill:replica:at=%d" % (
                 _calib_count(args.requests)
                 + args.requests // (12 if args.smoke else 6))
+    if args.disaggregated:
+        args.rolling = False  # r01 is the rolling-restart evidence
+        args.spec = ""  # kills are role-targeted (kill_one), not chaos
+        if args.reclaim_idle_ms == 1000.0:
+            args.reclaim_idle_ms = 250.0
 
     import tempfile
 
     work_dir = args.work_dir or tempfile.mkdtemp(prefix="fleet-soak-")
     features, vocab, embed = (
-        (ZIPF_FEATURES, ZIPF_VOCAB, ZIPF_EMBED) if args.zipf
+        (ZIPF_FEATURES, ZIPF_VOCAB, ZIPF_EMBED)
+        if args.zipf or args.disaggregated
         else (FEATURES, 50, 8))
     model_dir = build_model_dir(
         args.model_dir or os.path.join(work_dir, "model"),
@@ -379,17 +664,32 @@ def main():
         "AZT_ZOO_SERVING_FLEET_RECLAIM_IDLE_MS":
             str(args.reclaim_idle_ms),
     }
-    fc = FleetController(cfg, replicas=args.replicas,
+    fleet_kw: dict = {"replicas": args.replicas}
+    total_replicas = args.replicas
+    if args.disaggregated:
+        # every replica still serves predict (the model block rides
+        # along); the role split applies to the generation plane
+        cfg["generation"] = {
+            "model": {"vocab": 64, "dim": 32, "heads": 2,
+                      "head_dim": 16, "layers": 2, "seed": 0},
+            "max_tokens": args.gen_tokens,
+            "stream_chunk_tokens": 1}
+        env["AZT_ZOO_GENERATION_STEP_IDLE_MS"] = "5"
+        fleet_kw = {"prefill_replicas": args.prefill_replicas,
+                    "decode_replicas": args.decode_replicas}
+        total_replicas = args.prefill_replicas + args.decode_replicas
+        args.replicas = total_replicas
+    fc = FleetController(cfg,
                          work_dir=os.path.join(work_dir, "fleet"),
                          env=env, seed=args.seed,
                          poll_interval_s=0.2, health_interval_s=0.4,
-                         on_result=on_result)
+                         on_result=on_result, **fleet_kw)
     t0 = time.perf_counter()
     fc.start()
     rolling = {}
     extra: dict = {}
     try:
-        if not fc.wait_healthy(args.replicas, timeout_s=300):
+        if not fc.wait_healthy(total_replicas, timeout_s=300):
             print(json.dumps({"error": "fleet never became healthy",
                               "states": fc.replica_states(),
                               "recovered": False}))
@@ -397,7 +697,11 @@ def main():
 
         rng = np.random.RandomState(args.seed)
         xs = rng.randint(1, vocab, (64, features)).astype(np.int32)
-        if args.zipf:
+        if args.disaggregated:
+            # ---- split-pool drill: predict overload + generation
+            # lane, one SIGKILL per pool ----
+            extra = disagg_phase(args, fc, answered, answer_times, xs)
+        elif args.zipf:
             # ---- overload drill: paced 2x load through the real
             # brownout admission ladder, SIGKILL mid-run ----
             extra = zipf_phase(args, fc, answered, answer_times, xs)
@@ -441,6 +745,9 @@ def main():
         fc.stop()
         chaos.uninstall()
 
+    if os.environ.get("SOAK_DEBUG_ANSWERED"):
+        with open(os.environ["SOAK_DEBUG_ANSWERED"], "w") as fh:
+            json.dump(sorted(answered), fh)
     dups = sum(c - 1 for c in answered.values() if c > 1)
     # zipf mode: shed requests were never produced, so exactly-once
     # covers what the admission ladder let through (+ calibration)
@@ -458,6 +765,8 @@ def main():
     zipf_clean = (not args.zipf
                   or (extra.get("zipf_pass", False)
                       and fc.chaos_kills >= 1))
+    disagg_clean = (not args.disaggregated
+                    or extra.get("disagg_pass", False))
     line = {
         "requests": args.requests,
         "replicas": args.replicas,
@@ -475,7 +784,8 @@ def main():
         "seed": args.seed,
         "spec": args.spec,
         "exactly_once": exactly_once,
-        "recovered": exactly_once and rolling_clean and zipf_clean,
+        "recovered": (exactly_once and rolling_clean and zipf_clean
+                      and disagg_clean),
     }
     line.update(extra)
     print(json.dumps(line))

@@ -1,0 +1,424 @@
+"""Disaggregated prefill/decode serving (ISSUE-20).
+
+Covers the paged-KV handoff end to end: export/import round-trips
+that are token-exact against an uninterrupted decode (across page
+boundaries and cut points), structured ``generation_overflow``
+refusal when the importing pool is exhausted, the in-process split
+prefill/decode pipeline (token parity with the unified worker, the
+KV-dropped deterministic-regen fallback, drain-time stream moves),
+and the real split-pool fleet: /generate through the router into a
+prefill+decode topology, a decode-replica SIGKILL mid-stream that
+resumes token-exactly on a survivor, and a prefill-replica SIGKILL
+whose claimed requests are reclaimed and re-prefilled -- every
+stream delivered exactly once after chunk-seq dedup."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.inference.kv_cache import CacheOverflow
+from analytics_zoo_tpu.serving.generation.engine import DecodeEngine
+from analytics_zoo_tpu.serving.generation.model import (
+    GenModelConfig, TinyGenLM)
+from analytics_zoo_tpu.serving.generation.worker import GenerationWorker
+from analytics_zoo_tpu.serving.protocol import (
+    ERROR_KEY, GENERATION_PREFIX, STREAM_KEY)
+from analytics_zoo_tpu.serving.queues import (
+    MemQueue, _decode, _decode_handoff, _encode)
+
+TINY = GenModelConfig(vocab=32, dim=16, heads=2, head_dim=8, layers=2,
+                      max_len=64, seed=0)
+PAGE = 4
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TinyGenLM(TINY)
+
+
+def _engine(lm, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("max_len", 64)
+    return DecodeEngine(lm, **kw)
+
+
+def _decode_n(engine, prompt, n):
+    """Admit + greedy-decode ``n`` tokens on one engine, no handoff."""
+    slot, tok0 = engine.admit(np.asarray(prompt, np.int32), n)
+    toks = [int(tok0)]
+    while len(toks) < n:
+        toks.extend(int(t) for s, t in engine.step() if s == slot)
+    engine.release(slot)
+    return toks
+
+
+def _decode_n_handoff(src, dst, prompt, n, cut):
+    """Same stream, interrupted: ``cut`` tokens on ``src``, then
+    export -> import -> remaining tokens on ``dst``."""
+    slot, tok0 = src.admit(np.asarray(prompt, np.int32), n)
+    toks = [int(tok0)]
+    while len(toks) < cut:
+        toks.extend(int(t) for s, t in src.step() if s == slot)
+    snap = src.export_slot(slot)
+    src.release(slot)
+    assert snap["rng"] is None  # greedy decode: no sampler state
+    slot2 = dst.import_slot(snap)
+    while len(toks) < n:
+        toks.extend(int(t) for s, t in dst.step() if s == slot2)
+    dst.release(slot2)
+    return toks
+
+
+# ------------------------------------------------------------------ #
+# export/import exactness (engine level)                             #
+# ------------------------------------------------------------------ #
+class TestKVHandoffExactness:
+    def test_round_trip_token_exact_across_cut_points(self, lm):
+        """Property sweep: random prompts whose lengths and cut
+        points land on, before, and after page boundaries -- the
+        imported stream must continue token-exactly where the
+        uninterrupted decode would have."""
+        rng = np.random.default_rng(7)
+        n = 12
+        cases = [(1, 1), (3, 2), (4, 4),     # prompt at/below a page
+                 (5, 3), (9, 6),             # prompt spills a page
+                 (7, 8)]                     # cut crosses a boundary
+        for plen, cut in cases:
+            prompt = rng.integers(1, TINY.vocab, size=plen)
+            ref = _decode_n(_engine(lm), prompt, n)
+            got = _decode_n_handoff(_engine(lm), _engine(lm),
+                                    prompt, n, cut)
+            assert got == ref, (plen, cut, got, ref)
+
+    def test_import_refused_on_exhaustion(self, lm):
+        src = _engine(lm)
+        slot, _ = src.admit(np.asarray([1, 2, 3, 4], np.int32), 28)
+        snap = src.export_slot(slot)
+        src.release(slot)
+        small = _engine(lm, num_slots=2, max_len=16)
+        with pytest.raises(CacheOverflow):
+            small.import_slot(snap)
+        # the refusal left nothing behind: the small pool still admits
+        s2, _ = small.admit(np.asarray([1, 2], np.int32), 4)
+        small.release(s2)
+
+    def test_import_geometry_mismatch_is_value_error(self, lm):
+        src = _engine(lm)
+        slot, _ = src.admit(np.asarray([1, 2, 3], np.int32), 8)
+        snap = src.export_slot(slot)
+        src.release(slot)
+        other = _engine(lm, page_size=8, max_len=64)
+        with pytest.raises(ValueError):
+            other.import_slot(snap)
+
+    def test_client_blob_on_handoff_stream_is_value_error(self):
+        blob = _encode("u1", {"tokens": np.asarray([1, 2], np.int32)})
+        with pytest.raises(ValueError):
+            _decode_handoff(blob)
+
+
+# ------------------------------------------------------------------ #
+# split pipeline (in-process workers over MemQueues)                 #
+# ------------------------------------------------------------------ #
+def _drain_mem(out_q, n_terminals=1, timeout=30.0):
+    """Read one MemQueue of chunk blobs until ``n_terminals`` streams
+    end; returns ({uri: tokens}, {uri: seqs}, {uri: error})."""
+    toks, seqs, errs = {}, {}, {}
+    term = 0
+    deadline = time.monotonic() + timeout
+    while term < n_terminals and time.monotonic() < deadline:
+        blob = out_q.get(timeout=0.1)
+        if blob is None:
+            continue
+        uri, t = _decode(blob)
+        if ERROR_KEY in t:
+            errs[uri] = str(np.asarray(t[ERROR_KEY]).reshape(()))
+            term += 1
+            continue
+        seqs.setdefault(uri, []).append(
+            int(np.asarray(t[STREAM_KEY]).reshape(())))
+        if "token" in t:
+            toks.setdefault(uri, []).extend(
+                int(x) for x in np.asarray(t["token"]).reshape(-1))
+        if "finish_reason" in t:
+            term += 1
+    assert term == n_terminals, (toks, seqs, errs)
+    return toks, seqs, errs
+
+
+class TestSplitPipeline:
+    def _unified(self, lm, prompt, n):
+        inq, outq = MemQueue(), MemQueue()
+        w = GenerationWorker(_engine(lm), inq, outq, max_tokens=n,
+                             eos=-1)
+        w.start()
+        try:
+            inq.put(_encode("u", {"tokens": np.asarray(prompt,
+                                                       np.int32)}))
+            toks, seqs, errs = _drain_mem(outq)
+        finally:
+            w.stop()
+        assert not errs, errs
+        return toks["u"]
+
+    def _split_workers(self, lm, n, prefill_kw=None, decode_kw=None):
+        inq, outq, hq = MemQueue(), MemQueue(), MemQueue()
+        wp = GenerationWorker(_engine(lm, **(prefill_kw or {})), inq,
+                              outq, max_tokens=n, eos=-1,
+                              role="prefill", handoff_queue=hq)
+        wd = GenerationWorker(_engine(lm, **(decode_kw or {})), hq,
+                              outq, max_tokens=n, eos=-1,
+                              role="decode", handoff_queue=hq)
+        return inq, outq, hq, wp, wd
+
+    def test_split_pipeline_token_exact_vs_unified(self, lm):
+        prompt = [3, 9, 4, 17, 2, 28, 11]
+        n = 10
+        ref = self._unified(lm, prompt, n)
+        inq, outq, _hq, wp, wd = self._split_workers(lm, n)
+        wp.start()
+        wd.start()
+        try:
+            inq.put(_encode("u", {"tokens": np.asarray(prompt,
+                                                       np.int32)}))
+            toks, seqs, errs = _drain_mem(outq)
+        finally:
+            wp.stop()
+            wd.stop()
+        assert not errs, errs
+        assert toks["u"] == ref
+        assert seqs["u"] == sorted(set(seqs["u"]))  # gapless, no dups
+        assert wp.metrics()["handoffs"].get("export", 0) == 1
+        assert wd.metrics()["handoffs"].get("import", 0) == 1
+
+    def test_kv_dropped_handoff_regenerates_token_exact(self, lm):
+        """A snapshot past ``handoff_max_bytes`` is dropped at publish;
+        the decode side deterministically re-prefills from the prompt
+        and still produces the exact token stream."""
+        prompt = [5, 1, 30, 12, 7]
+        n = 8
+        ref = self._unified(lm, prompt, n)
+        cfg = get_config()
+        cfg.set("zoo.serving.fleet.handoff_max_bytes", 1)
+        try:
+            inq, outq, _hq, wp, wd = self._split_workers(lm, n)
+        finally:
+            cfg.unset("zoo.serving.fleet.handoff_max_bytes")
+        wp.start()
+        wd.start()
+        try:
+            inq.put(_encode("u", {"tokens": np.asarray(prompt,
+                                                       np.int32)}))
+            toks, _seqs, errs = _drain_mem(outq)
+        finally:
+            wp.stop()
+            wd.stop()
+        assert not errs, errs
+        assert toks["u"] == ref
+        assert wd.metrics()["handoffs"].get("regen", 0) == 1
+
+    def test_decode_pool_exhaustion_refused_structured(self, lm):
+        """An import the decode pool cannot hold is refused with the
+        structured ``generation_overflow`` terminal -- same contract
+        as first admission, never a silent drop."""
+        n = 28  # reserve 8 pages: beyond the decode pool's max_len 16
+        inq, outq, _hq, wp, wd = self._split_workers(
+            lm, n, decode_kw={"max_len": 16})
+        wp.start()
+        wd.start()
+        try:
+            inq.put(_encode("u", {"tokens": np.asarray([1, 2, 3],
+                                                       np.int32)}))
+            _toks, _seqs, errs = _drain_mem(outq)
+        finally:
+            wp.stop()
+            wd.stop()
+        assert errs["u"].startswith(GENERATION_PREFIX), errs
+        assert wd.metrics()["handoffs"].get("refused", 0) == 1
+
+    def test_drain_moves_live_streams_to_survivor(self, lm):
+        """Decode-role drain re-publishes in-flight streams (KV
+        snapshot + replay state); a second decode worker finishes them
+        with no seq gap and token-exact output."""
+        prompt = [3, 9, 4, 17, 2, 28, 11]
+        n = 40  # long enough that the drain lands mid-stream
+        ref = self._unified(lm, prompt, n)
+        inq, outq, hq, wp, wa = self._split_workers(lm, n)
+        wp.start()
+        wa.start()
+        inq.put(_encode("u", {"tokens": np.asarray(prompt,
+                                                   np.int32)}))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and wa.served == 0:
+            if any(s.produced >= 3 for s in wa._streams.values()):
+                break
+            time.sleep(0.005)
+        assert wa.drain(10.0)
+        assert wa.served == 0, "stream should have MOVED, not finished"
+        assert wa.metrics()["handoffs"].get("moved", 0) == 1
+        wb = GenerationWorker(_engine(lm), hq, outq, max_tokens=n,
+                              eos=-1, role="decode", handoff_queue=hq)
+        wb.start()
+        try:
+            toks, seqs, errs = _drain_mem(outq)
+        finally:
+            wp.stop()
+            wb.stop()
+        assert not errs, errs
+        assert toks["u"] == ref
+        assert seqs["u"] == sorted(set(seqs["u"]))
+
+
+# ------------------------------------------------------------------ #
+# split-pool fleet end to end (real replica processes)               #
+# ------------------------------------------------------------------ #
+FLEET_MODEL = {"vocab": 64, "dim": 32, "heads": 2, "head_dim": 16,
+               "layers": 2, "seed": 0}
+
+
+def _reference_tokens(prompt, n):
+    # built exactly as the launcher builds replica engines, so the
+    # reference decode is the same compiled computation
+    from analytics_zoo_tpu.serving.generation.engine import (
+        engine_from_config)
+
+    eng = engine_from_config({"model": dict(FLEET_MODEL)})
+    return _decode_n(eng, prompt, n)
+
+
+def _sse_generate(address, payload, events, done):
+    req = urllib.request.Request(
+        address + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=180) as resp:
+        for line in resp:
+            line = line.strip()
+            if line.startswith(b"data: "):
+                events.append(json.loads(line[6:]))
+    done.set()
+
+
+class TestDisaggregatedFleetEndToEnd:
+    @pytest.mark.slow
+    def test_split_pools_with_kills_exactly_once(self, tmp_path):
+        """One split-pool fleet (2 prefill + 2 decode), two drills:
+        (1) SIGKILL the serving decode replica mid-stream -> the
+        survivor resumes from the handed-off KV snapshot and the
+        client sees one gapless, token-exact stream with exactly one
+        terminal; (2) SIGKILL a prefill replica under a request burst
+        -> its claimed requests are reclaimed and re-prefilled, every
+        stream delivered exactly once after chunk-seq dedup."""
+        from analytics_zoo_tpu.serving.fleet import FleetController
+        from analytics_zoo_tpu.serving.redis_adapter import (
+            RedisStreamQueue)
+
+        cfg = {"generation": {"model": dict(FLEET_MODEL),
+                              "max_tokens": 48,
+                              "stream_chunk_tokens": 1},
+               "http": {"enabled": True}}
+        env = {"JAX_PLATFORMS": "cpu",
+               "AZT_ZOO_SERVING_FLEET_RECLAIM_IDLE_MS": "500",
+               "AZT_ZOO_GENERATION_STEP_IDLE_MS": "5"}
+        fc = FleetController(cfg, prefill_replicas=2,
+                             decode_replicas=2,
+                             work_dir=str(tmp_path / "fleet"),
+                             env=env, poll_interval_s=0.2,
+                             health_interval_s=0.4)
+        fc.start()
+        try:
+            assert fc.wait_healthy(4, timeout_s=300), (
+                fc.replica_states())
+            st = fc.stats()
+            assert st["pools"]["prefill"]["healthy"] == 2
+            assert st["pools"]["decode"]["healthy"] == 2
+
+            # ---- drill 1: decode SIGKILL mid-stream ----
+            ref = _reference_tokens([1, 2, 3], 40)
+            events, done = [], threading.Event()
+            t = threading.Thread(
+                target=_sse_generate, args=(
+                    fc.router.address,
+                    {"prompt": [1, 2, 3], "max_tokens": 40},
+                    events, done),
+                daemon=True)
+            t.start()
+            deadline = time.time() + 60
+            while (sum(1 for e in events if "seq" in e) < 4
+                   and time.time() < deadline):
+                time.sleep(0.05)
+            victim = fc.kill_one("decode", reason="drill")
+            assert victim is not None and victim.startswith("d")
+            assert done.wait(180), "stream never terminated"
+            # client-side chunk-seq dedup, the exactly-once contract
+            toks, last, terms = [], -1, 0
+            for e in events:
+                seq = e.get("seq")
+                if seq is None or seq <= last:
+                    continue
+                assert seq == last + 1, f"seq gap: {events}"
+                last = seq
+                toks.extend(e.get("token", []))
+                if "finish_reason" in e:
+                    terms += 1
+            assert not any("error" in e for e in events), events
+            assert terms == 1
+            assert toks == ref, (toks, ref)
+
+            # ---- drill 2: prefill SIGKILL under a burst ----
+            assert fc.wait_healthy(4, timeout_s=180)
+            n_req, n_tok = 48, 8
+            prod = RedisStreamQueue(fc.broker_address,
+                                    stream=fc.gen_stream)
+            rng = np.random.default_rng(3)
+            prompts = {f"g{i:03d}": rng.integers(1, 64, size=4)
+                       for i in range(n_req)}
+            for uri, p in prompts.items():
+                assert prod.put(_encode(
+                    uri, {"tokens": np.asarray(p, np.int32)},
+                    reply_to="disagg_drill_replies",
+                    max_tokens=n_tok))
+            victim = fc.kill_one("prefill", reason="drill")
+            assert victim is not None and victim.startswith("p")
+
+            sub = RedisStreamQueue(fc.broker_address,
+                                   stream="disagg_drill_replies",
+                                   group="drill_sub", consumer="t0",
+                                   autoack=True)
+            got = {u: {"last": -1, "toks": [], "terms": 0}
+                   for u in prompts}
+            terms = 0
+            deadline = time.time() + 240
+            while terms < n_req and time.time() < deadline:
+                blob = sub.get(timeout=0.2)
+                if blob is None:
+                    continue
+                uri, tens = _decode(blob)
+                rec = got[uri]
+                assert ERROR_KEY not in tens, (
+                    uri, np.asarray(tens[ERROR_KEY]))
+                seq = int(np.asarray(tens[STREAM_KEY]).reshape(()))
+                if seq <= rec["last"]:
+                    continue  # replayed chunk: deduped by seq
+                assert seq == rec["last"] + 1, (uri, seq, rec)
+                rec["last"] = seq
+                if "token" in tens:
+                    rec["toks"].extend(
+                        int(x) for x in
+                        np.asarray(tens["token"]).reshape(-1))
+                if "finish_reason" in tens:
+                    rec["terms"] += 1
+                    terms += 1
+            assert terms == n_req, {
+                u: r for u, r in got.items() if r["terms"] != 1}
+            assert all(r["terms"] == 1 for r in got.values())
+            assert all(len(r["toks"]) == n_tok
+                       for r in got.values()), {
+                u: len(r["toks"]) for u, r in got.items()}
+        finally:
+            fc.stop()

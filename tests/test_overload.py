@@ -42,7 +42,8 @@ from analytics_zoo_tpu.serving.queues import (
     InputQueue, OutputQueue, _decode_generation, _decode_predict,
     _encode)
 from analytics_zoo_tpu.serving.spawn import (
-    LocalSpawnBackend, ManifestSpawnBackend, make_spawn_backend)
+    LocalSpawnBackend, ManifestSpawnBackend, RemoteSpawnBackend,
+    make_spawn_backend)
 
 GOLDEN = Path(__file__).parent / "golden"
 
@@ -479,8 +480,139 @@ class TestSpawnBackends:
                               ManifestSpawnBackend)
         finally:
             cfg.unset("zoo.serving.fleet.spawn_backend")
+        cfg.set("zoo.serving.fleet.spawn_backend", "remote")
+        cfg.set("zoo.serving.fleet.remote_runner", "ssh worker-3")
+        try:
+            be = make_spawn_backend()
+            assert isinstance(be, RemoteSpawnBackend)
+            assert be.runner == ["ssh", "worker-3"]
+        finally:
+            cfg.unset("zoo.serving.fleet.spawn_backend")
+            cfg.unset("zoo.serving.fleet.remote_runner")
         with pytest.raises(ValueError):
             make_spawn_backend("bogus")
+
+    def test_remote_backend_popen_equivalence(self, tmp_path):
+        """Empty runner = the degenerate remote target: same Popen
+        lifecycle as the local backend (the PR-15 equivalence suite),
+        with signals delivered to the driver's process group."""
+        be = RemoteSpawnBackend(runner=[])
+        log = tmp_path / "r0.log"
+        h = be.spawn(
+            "r0",
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            str(log), dict(os.environ))
+        try:
+            assert h.poll() is None
+            ident = be.identity(h)
+            assert ident is not None
+            assert be.identity_matches(h, ident)
+            be.signal(h, signal.SIGTERM)
+            assert h.wait(10.0) == -signal.SIGTERM
+        finally:
+            if h.poll() is None:
+                h.kill()
+                h.wait(10.0)
+        assert log.exists()
+
+    def test_remote_runner_prefixes_argv_and_forwards_env(
+            self, tmp_path):
+        """A non-empty runner executes ``runner + env K=V... + argv``:
+        the replica runs on another substrate, so config-bearing env
+        (AZT_*/JAX_*/XLA_*/PYTHONPATH) crosses as an ``env`` command
+        prefix -- and nothing else leaks across."""
+        seen = tmp_path / "seen.txt"
+        runner = [sys.executable, "-c",
+                  "import sys, time\n"
+                  f"open({str(seen)!r}, 'w').write("
+                  "'\\x00'.join(sys.argv[1:]))\n"
+                  "time.sleep(60)"]
+        be = RemoteSpawnBackend(runner=runner)
+        env = {"AZT_ZOO_SERVING_FLEET_BIND_HOST": "0.0.0.0",
+               "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": "/srv/zoo",
+               "HOME": "/root",
+               "SECRET_TOKEN": "nope"}
+        h = be.spawn("r0", ["python", "-m", "zoo.replica"],
+                     str(tmp_path / "r0.log"), env)
+        try:
+            deadline = time.monotonic() + 10
+            while not seen.exists() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            parts = seen.read_text().split("\x00")
+            assert parts[0] == "env"
+            assert parts[-3:] == ["python", "-m", "zoo.replica"]
+            forwarded = parts[1:-3]
+            assert ("AZT_ZOO_SERVING_FLEET_BIND_HOST=0.0.0.0"
+                    in forwarded)
+            assert "JAX_PLATFORMS=cpu" in forwarded
+            assert "PYTHONPATH=/srv/zoo" in forwarded
+            assert not any(p.startswith(("HOME=", "SECRET_TOKEN="))
+                           for p in forwarded)
+        finally:
+            be.signal(h, signal.SIGKILL)
+            h.wait(10.0)
+
+    @pytest.mark.slow
+    def test_rolling_restart_through_remote_keeps_capacity(
+            self, tmp_path):
+        """Acceptance (ISSUE-20): a rolling restart driven through
+        RemoteSpawnBackend holds capacity >= N-1 with zero 5xx from
+        the router under live /generate traffic."""
+        import threading
+
+        cfg = {"generation": {"model": {"vocab": 64, "dim": 32,
+                                        "heads": 2, "head_dim": 16,
+                                        "layers": 2, "seed": 0},
+                              "max_tokens": 4},
+               "http": {"enabled": True}}
+        fc = FleetController(cfg, replicas=3,
+                             work_dir=str(tmp_path / "fleet"),
+                             env={"JAX_PLATFORMS": "cpu"},
+                             poll_interval_s=0.2,
+                             health_interval_s=0.4,
+                             spawn_backend=RemoteSpawnBackend(
+                                 runner=[]))
+        fc.start()
+        try:
+            assert fc.wait_healthy(3, timeout_s=300), (
+                fc.replica_states())
+            codes: dict = {}
+            stop = threading.Event()
+
+            def load():
+                body = json.dumps({"prompt": [1, 2, 3],
+                                   "max_tokens": 2}).encode()
+                while not stop.is_set():
+                    try:
+                        req = urllib.request.Request(
+                            fc.router.address + "/generate",
+                            data=body,
+                            headers={"Content-Type":
+                                     "application/json"})
+                        with urllib.request.urlopen(
+                                req, timeout=60) as resp:
+                            resp.read()
+                            code = resp.status
+                    except urllib.error.HTTPError as e:
+                        code = e.code
+                    except (urllib.error.URLError, OSError):
+                        code = -1
+                    codes[code] = codes.get(code, 0) + 1
+
+            t = threading.Thread(target=load, daemon=True)
+            t.start()
+            ok = fc.rolling_restart(timeout_s=240)
+            stop.set()
+            t.join(65.0)
+            assert ok, fc.stats()
+            bad = {c: n for c, n in codes.items()
+                   if c >= 500 or c < 0}
+            assert not bad, codes
+            assert codes.get(200, 0) > 0
+            assert fc.min_healthy_during_restart >= 2
+        finally:
+            fc.stop()
 
     def test_controller_lifecycle_through_manifest(self, tmp_path):
         be = ManifestSpawnBackend()

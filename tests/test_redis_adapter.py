@@ -368,3 +368,56 @@ def _drain_input(in_q):
             break
         items.append(_decode_full(blob))
     return items
+
+
+# ---------------------------------------------- broker liveness ------
+class TestBrokerProbe:
+    """ISSUE-20 satellite: probe_broker/wait_broker readiness gate."""
+
+    def test_probe_true_against_live_broker(self, adapter):
+        from analytics_zoo_tpu.serving.redis_adapter import probe_broker
+
+        fe, _, _ = adapter
+        assert probe_broker(f"127.0.0.1:{fe.port}") is True
+        assert probe_broker(f"redis://127.0.0.1:{fe.port}") is True
+
+    def test_probe_false_against_closed_port(self):
+        from analytics_zoo_tpu.serving.redis_adapter import probe_broker
+
+        # bind-then-close guarantees nothing listens on the port
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        assert probe_broker(f"127.0.0.1:{port}", timeout_s=0.5) is False
+
+    def test_wait_broker_backs_off_and_emits_one_event(self):
+        from analytics_zoo_tpu.obs.events import get_event_log
+        from analytics_zoo_tpu.serving.redis_adapter import wait_broker
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        log = get_event_log()
+        before = len(log.tail(type="broker_unreachable"))
+        t0 = time.monotonic()
+        ok = wait_broker(f"127.0.0.1:{port}", retries=3, base_s=0.05,
+                         max_s=0.1, timeout_s=0.2)
+        waited = time.monotonic() - t0
+        assert ok is False
+        # 0.05 + 0.1 + 0.1 of backoff between the 4 attempts
+        assert waited >= 0.25
+        evts = log.tail(type="broker_unreachable")
+        assert len(evts) == before + 1
+        assert evts[-1]["fields"]["retries"] == 3
+
+    def test_wait_broker_succeeds_without_event(self, adapter):
+        from analytics_zoo_tpu.obs.events import get_event_log
+        from analytics_zoo_tpu.serving.redis_adapter import wait_broker
+
+        fe, _, _ = adapter
+        log = get_event_log()
+        before = len(log.tail(type="broker_unreachable"))
+        assert wait_broker(f"127.0.0.1:{fe.port}", retries=1) is True
+        assert len(log.tail(type="broker_unreachable")) == before

@@ -800,3 +800,63 @@ class TestLifecycleCLI:
         assert "parse" in r.stderr
         # stdout stays the normal text report
         assert "leak-on-path" in r.stdout
+
+
+# ===================================================================== #
+# ISSUE-20: kv-handoff snapshots are lifecycle resources               #
+# ===================================================================== #
+class TestKVHandoffLifecycle:
+    """An exported KV snapshot must reach the wire (_encode_handoff),
+    an importer (import_slot/import_pages), or a named abandonment
+    (_discard_handoff) on every path -- anything else is a silently
+    dropped generation stream."""
+
+    def test_abandoned_handoff_is_leak_on_path(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Worker:
+                def hand_off(self, engine, slot, cond):
+                    snap = engine.export_slot(slot)
+                    if cond:
+                        return None
+                    return self._publish(snap)
+            """)
+        assert "leak-on-path" in rules_of(fs)
+
+    def test_encoded_handoff_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Worker:
+                def hand_off(self, engine, slot, uri, prompt, state):
+                    snap = engine.export_slot(slot)
+                    blob = _encode_handoff(uri, prompt, state, snap)
+                    return blob
+            """)
+        assert fs == []
+
+    def test_discarded_handoff_on_failure_path_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            class Worker:
+                def hand_off(self, engine, slot, uri, prompt, state):
+                    snap = None
+                    try:
+                        snap = engine.export_slot(slot)
+                        blob = _encode_handoff(uri, prompt, state, snap)
+                    except Exception:
+                        _discard_handoff(snap)
+                        return None
+                    return blob
+            """)
+        assert fs == []
+
+    def test_imported_handoff_is_clean(self, tmp_path):
+        # the importer binds the new slot and installs it into an
+        # instance container (ownership transfer) -- the shape
+        # _import_blob actually uses
+        fs = lint(tmp_path, """
+            class Worker:
+                def receive(self, engine, src, slot):
+                    snap = src.export_slot(slot)
+                    new = engine.import_slot(snap)
+                    self._streams[new] = snap
+                    return 0
+            """)
+        assert fs == []
